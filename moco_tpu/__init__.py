@@ -1,0 +1,19 @@
+"""moco_tpu — a TPU-native momentum-contrast (MoCo) framework on JAX/XLA.
+
+A ground-up re-design of the capabilities of the reference repo
+(thudzj/moco, a fork of facebookresearch/moco): momentum-contrast
+self-supervised pretraining (v1/v2 queue-based InfoNCE, v3 queue-free),
+linear-probe evaluation, and detection-transfer export — built TPU-first:
+
+- SPMD over a `jax.sharding.Mesh` (ICI/DCN) instead of one-process-per-GPU
+  NCCL DDP (`main_moco.py:~L135-180` in the reference).
+- Functional state (`params_q, params_k, queue, queue_ptr, opt_state`)
+  threaded through a jitted `train_step`, replacing the reference's
+  mutable `register_buffer` queue + in-place EMA (`moco/builder.py`).
+- Deterministic same-seed permutation replaces the reference's
+  broadcast-a-permutation Shuffle-BN (`moco/builder.py:~L79-126`).
+- Batched on-device augmentation (crop/jitter/blur on the TPU) replaces
+  the 32-worker PIL pipeline (`moco/loader.py`).
+"""
+
+__version__ = "0.1.0"

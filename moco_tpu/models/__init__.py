@@ -1,0 +1,12 @@
+from moco_tpu.models.resnet import ARCHS, BasicBlock, Bottleneck, ResNet, create_resnet
+from moco_tpu.models.heads import LinearClassifier, ProjectionHead
+
+__all__ = [
+    "ARCHS",
+    "BasicBlock",
+    "Bottleneck",
+    "ResNet",
+    "create_resnet",
+    "LinearClassifier",
+    "ProjectionHead",
+]

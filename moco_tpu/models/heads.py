@@ -1,0 +1,51 @@
+"""Projection / classifier heads.
+
+The reference creates heads by surgery on the torchvision encoder's `fc`:
+- v1: `base_encoder(num_classes=dim)` leaves a single Linear fc
+  (`moco/builder.py:~L20`).
+- v2 (`mlp=True`): `fc = Sequential(Linear(dim_mlp, dim_mlp), ReLU, fc)`
+  (`moco/builder.py:~L25-30`).
+- linear probe: fresh fc with weight~N(0, 0.01), bias=0
+  (`main_lincls.py:~L160-165`).
+
+Here heads are standalone modules composed with the backbone instead.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ProjectionHead(nn.Module):
+    """MoCo projection head: Linear (v1) or 2-layer MLP (v2)."""
+
+    dim: int = 128
+    mlp: bool = False
+    hidden_dim: int | None = None  # defaults to input feature dim, as in v2
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        if self.mlp:
+            hidden = self.hidden_dim or x.shape[-1]
+            x = nn.Dense(hidden, dtype=self.dtype)(x)
+            x = nn.relu(x)
+        x = nn.Dense(self.dim, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+class LinearClassifier(nn.Module):
+    """Linear-probe classifier with the reference's init
+    (`main_lincls.py:~L160-165`: weight~N(0, 0.01), bias=0)."""
+
+    num_classes: int = 1000
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(
+            self.num_classes,
+            kernel_init=nn.initializers.normal(stddev=0.01),
+            bias_init=nn.initializers.zeros,
+        )(x.astype(jnp.float32))

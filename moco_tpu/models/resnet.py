@@ -1,0 +1,176 @@
+"""ResNet encoder family (Flax), torchvision-architecture-compatible.
+
+The reference builds its encoders from `torchvision.models.resnet*`
+(`main_moco.py:~L160`: `moco.builder.MoCo(models.__dict__[arch], ...)`).
+This is a TPU-first reimplementation: NHWC layout (XLA's preferred conv
+layout on TPU), bf16 compute / fp32 params+BN-stats, and a BatchNorm whose
+cross-replica behavior is a constructor knob so the same module serves
+
+- per-device BN (required by Shuffle-BN, `moco/builder.py:~L79-126`), and
+- cross-replica SyncBN over optional subgroups (the reference only uses
+  SyncBN in detection transfer, `detection/configs/Base-RCNN-C4-BN.yaml`).
+
+Architecture parity notes vs torchvision ResNet v1:
+- 7x7 stride-2 stem + 3x3 stride-2 maxpool (or a 3x3 stride-1 CIFAR stem).
+- BasicBlock for resnet18/34, Bottleneck (expansion 4) for resnet50/101/152.
+- Downsampling via 1x1 stride-2 conv in the residual branch ("v1.5": the
+  3x3 conv in Bottleneck carries the stride, matching torchvision).
+- Conv init: He normal (fan_out), BN gamma=1 beta=0; no conv bias.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+# He-normal fan_out matches torchvision's kaiming_normal_(mode="fan_out").
+conv_kernel_init = nn.initializers.variance_scaling(2.0, "fan_out", "truncated_normal")
+
+
+class ConvBN(nn.Module):
+    """Conv (no bias) + BatchNorm, the repeated cell of every block."""
+
+    features: int
+    kernel_size: int
+    strides: int = 1
+    norm: ModuleDef = nn.BatchNorm
+    scale_init: Callable = nn.initializers.ones
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(
+            self.features,
+            (self.kernel_size, self.kernel_size),
+            strides=self.strides,
+            padding=[(self.kernel_size // 2, self.kernel_size // 2)] * 2,
+            use_bias=False,
+            kernel_init=conv_kernel_init,
+            dtype=x.dtype,
+        )(x)
+        x = self.norm(scale_init=self.scale_init)(x)
+        return x
+
+
+class BasicBlock(nn.Module):
+    features: int
+    strides: int = 1
+    norm: ModuleDef = nn.BatchNorm
+    expansion: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = ConvBN(self.features, 3, self.strides, self.norm)(x)
+        y = nn.relu(y)
+        y = ConvBN(self.features, 3, 1, self.norm)(y)
+        if residual.shape != y.shape:
+            residual = ConvBN(self.features, 1, self.strides, self.norm)(x)
+        return nn.relu(y + residual)
+
+
+class Bottleneck(nn.Module):
+    features: int
+    strides: int = 1
+    norm: ModuleDef = nn.BatchNorm
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = ConvBN(self.features, 1, 1, self.norm)(x)
+        y = nn.relu(y)
+        # v1.5: stride on the 3x3, as torchvision does.
+        y = ConvBN(self.features, 3, self.strides, self.norm)(y)
+        y = nn.relu(y)
+        y = ConvBN(self.features * self.expansion, 1, 1, self.norm)(y)
+        if residual.shape != y.shape:
+            residual = ConvBN(self.features * self.expansion, 1, self.strides, self.norm)(x)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """ResNet backbone returning pooled features (the pre-`fc` activations).
+
+    The classifier / projection head is deliberately NOT part of this
+    module: the reference swaps the encoder's `fc` for a MoCo MLP head
+    (`moco/builder.py:~L25-30`) and the linear probe re-attaches a fresh
+    `fc` (`main_lincls.py:~L150-165`); keeping the head separate makes
+    both operations explicit instead of module surgery.
+    """
+
+    stage_sizes: Sequence[int]
+    block: ModuleDef = Bottleneck
+    num_filters: int = 64
+    cifar_stem: bool = False  # 3x3/s1 stem, no maxpool (32x32 inputs)
+    dtype: jnp.dtype = jnp.float32
+    bn_momentum: float = 0.9  # torch BN momentum 0.1 == flax momentum 0.9
+    bn_epsilon: float = 1e-5
+    # Cross-replica BN: None = per-device statistics (Shuffle-BN mode);
+    # an axis name = SyncBN over that mesh axis (optionally subgrouped).
+    bn_cross_replica_axis: Optional[str] = None
+    bn_axis_index_groups: Optional[Sequence[Sequence[int]]] = None
+
+    @property
+    def num_features(self) -> int:
+        return self.num_filters * (2 ** (len(self.stage_sizes) - 1)) * self.block.expansion
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = functools.partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=self.bn_momentum,
+            epsilon=self.bn_epsilon,
+            dtype=self.dtype,
+            axis_name=self.bn_cross_replica_axis,
+            axis_index_groups=self.bn_axis_index_groups,
+        )
+        x = x.astype(self.dtype)
+        if self.cifar_stem:
+            x = ConvBN(self.num_filters, 3, 1, norm)(x)
+            x = nn.relu(x)
+        else:
+            x = nn.Conv(
+                self.num_filters,
+                (7, 7),
+                strides=2,
+                padding=[(3, 3), (3, 3)],
+                use_bias=False,
+                kernel_init=conv_kernel_init,
+                dtype=self.dtype,
+            )(x)
+            x = norm()(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        for i, num_blocks in enumerate(self.stage_sizes):
+            for j in range(num_blocks):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = self.block(self.num_filters * 2**i, strides, norm)(x)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        return x.astype(jnp.float32)
+
+
+_CONFIGS = {
+    "resnet18": dict(stage_sizes=[2, 2, 2, 2], block=BasicBlock),
+    "resnet34": dict(stage_sizes=[3, 4, 6, 3], block=BasicBlock),
+    "resnet50": dict(stage_sizes=[3, 4, 6, 3], block=Bottleneck),
+    "resnet101": dict(stage_sizes=[3, 4, 23, 3], block=Bottleneck),
+    "resnet152": dict(stage_sizes=[3, 8, 36, 3], block=Bottleneck),
+}
+
+
+def create_resnet(arch: str, **kwargs) -> ResNet:
+    """Factory mirroring `torchvision.models.__dict__[arch]` lookup
+    (`main_moco.py:~L160`)."""
+    if arch not in _CONFIGS:
+        raise ValueError(f"unknown arch {arch!r}; choose from {sorted(_CONFIGS)}")
+    return ResNet(**_CONFIGS[arch], **kwargs)
+
+
+ARCHS = tuple(sorted(_CONFIGS))

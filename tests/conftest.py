@@ -1,0 +1,23 @@
+"""Test harness: force an 8-virtual-device CPU platform.
+
+This is the JAX-native answer to "test multi-node without a cluster"
+(SURVEY.md §4): `--xla_force_host_platform_device_count=8` gives 8
+CpuDevices, so every cross-replica pattern (shuffle-BN, queue lockstep,
+grad psum) runs under a real Mesh in CI.
+
+Must run before jax initializes a backend; the environment may pin
+JAX_PLATFORMS to a TPU tunnel, so we override both the env var and the
+config flag.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)

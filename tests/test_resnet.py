@@ -1,0 +1,85 @@
+"""Model-family tests: shapes, parameter counts vs torchvision, BN modes."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from moco_tpu.models import create_resnet, ProjectionHead, LinearClassifier
+
+
+def n_params(tree):
+    return sum(np.prod(x.shape) for x in jax.tree.leaves(tree))
+
+
+# torchvision backbone param counts (fc excluded), ground truth from
+# torchvision.models.resnet*(num_classes=...) minus fc params.
+TORCHVISION_BACKBONE_PARAMS = {
+    "resnet18": 11_176_512,
+    "resnet50": 23_508_032,
+}
+
+
+@pytest.mark.parametrize("arch", ["resnet18", "resnet50"])
+def test_param_count_matches_torchvision(arch):
+    model = create_resnet(arch)
+    variables = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)), train=False)
+    got = n_params(variables["params"])
+    assert got == TORCHVISION_BACKBONE_PARAMS[arch], (arch, got)
+
+
+def test_forward_shapes_and_features():
+    model = create_resnet("resnet18", cifar_stem=True)
+    variables = model.init(jax.random.key(0), jnp.zeros((2, 32, 32, 3)), train=False)
+    out = model.apply(variables, jnp.ones((2, 32, 32, 3)), train=False)
+    assert out.shape == (2, 512)
+    assert model.num_features == 512
+    assert create_resnet("resnet50").num_features == 2048
+
+
+def test_train_mode_updates_batch_stats():
+    model = create_resnet("resnet18", cifar_stem=True)
+    x = jax.random.normal(jax.random.key(1), (4, 16, 16, 3))
+    variables = model.init(jax.random.key(0), x, train=False)
+    _, mutated = model.apply(variables, x, train=True, mutable=["batch_stats"])
+    before = jax.tree.leaves(variables["batch_stats"])
+    after = jax.tree.leaves(mutated["batch_stats"])
+    assert any(not np.allclose(b, a) for b, a in zip(before, after))
+
+
+def test_eval_mode_is_deterministic_wrt_batch():
+    """Eval BN must use running stats: per-sample output independent of
+    batch composition."""
+    model = create_resnet("resnet18", cifar_stem=True)
+    x = jax.random.normal(jax.random.key(1), (4, 16, 16, 3))
+    variables = model.init(jax.random.key(0), x, train=False)
+    full = model.apply(variables, x, train=False)
+    half = model.apply(variables, x[:2], train=False)
+    np.testing.assert_allclose(full[:2], half, rtol=1e-3, atol=1e-5)
+
+
+def test_bf16_compute_fp32_out():
+    model = create_resnet("resnet18", cifar_stem=True, dtype=jnp.bfloat16)
+    x = jnp.ones((2, 16, 16, 3))
+    variables = model.init(jax.random.key(0), x, train=False)
+    out = model.apply(variables, x, train=False)
+    assert out.dtype == jnp.float32
+    # params stay fp32 (param_dtype default)
+    assert all(p.dtype == jnp.float32 for p in jax.tree.leaves(variables["params"]))
+
+
+def test_projection_heads():
+    feats = jnp.ones((2, 512))
+    for mlp, expect_params in [(False, 512 * 128 + 128), (True, 512 * 512 + 512 + 512 * 128 + 128)]:
+        head = ProjectionHead(dim=128, mlp=mlp)
+        v = head.init(jax.random.key(0), feats)
+        assert head.apply(v, feats).shape == (2, 128)
+        assert n_params(v["params"]) == expect_params
+
+
+def test_linear_classifier_init():
+    head = LinearClassifier(num_classes=10)
+    v = head.init(jax.random.key(0), jnp.ones((2, 512)))
+    k = v["params"]["Dense_0"]["kernel"]
+    assert np.abs(k).std() < 0.02 and not np.allclose(k, 0)
+    assert np.allclose(v["params"]["Dense_0"]["bias"], 0)
