@@ -1,0 +1,25 @@
+from moco_tpu.core.ema import ema_update
+from moco_tpu.core.moco import (
+    MoCoEncoder,
+    MocoState,
+    build_encoder,
+    create_state,
+    make_train_step,
+    place_state,
+    state_specs,
+)
+from moco_tpu.core.queue import check_queue_divisibility, enqueue, init_queue
+
+__all__ = [
+    "ema_update",
+    "MoCoEncoder",
+    "MocoState",
+    "build_encoder",
+    "create_state",
+    "make_train_step",
+    "place_state",
+    "state_specs",
+    "check_queue_divisibility",
+    "enqueue",
+    "init_queue",
+]

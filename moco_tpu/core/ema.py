@@ -1,0 +1,18 @@
+"""Momentum (EMA) key-encoder update.
+
+Reference: `moco/builder.py:~L52-60` — under `@torch.no_grad()`,
+`param_k = param_k * m + param_q * (1 - m)`, run once per step before the
+key forward. There it relies on DDP keeping every rank's `encoder_q`
+bit-identical so the per-rank local EMA stays in lockstep; here the state
+is functional and threaded through the jitted step, so lockstep is
+structural, not a protocol invariant.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def ema_update(params_k, params_q, momentum: float):
+    """params_k <- params_k * m + params_q * (1 - m), elementwise over the tree."""
+    return jax.tree.map(lambda k, q: k * momentum + q * (1.0 - momentum), params_k, params_q)

@@ -1,0 +1,330 @@
+"""The MoCo algorithm as a pure SPMD train step.
+
+This is the TPU-first re-design of `moco/builder.py` + the hot loop of
+`main_moco.py:~L262-310`. Instead of a stateful `nn.Module` with
+registered buffers mutated per rank under DDP, the whole algorithm is one
+pure function
+
+    train_step(state, batch, root_rng) -> (state, metrics)
+
+jitted once over a `jax.sharding.Mesh` via `shard_map`. The reference's
+trickiest invariant — queue + EMA replicas staying bit-identical across
+ranks with no dedicated sync traffic (SURVEY.md §2.3) — is structural
+here: replicated state in, deterministic math, replicated state out.
+
+Per-step collectives (vs the reference's 3× all_gather + 1× broadcast +
+DDP all-reduce, `SURVEY.md §3.1`):
+- shuffle='gather_perm': 2× all_gather (images, embeddings; the
+  broadcast is replaced by same-seed randomness, and the queue reuses
+  the unshuffle gather — one collective fewer than upstream)
+- shuffle='ring': 2× ppermute + 1× small all_gather
+- 1× psum for gradients (the DDP bucketed all-reduce equivalent)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from moco_tpu.core.ema import ema_update
+from moco_tpu.core.queue import check_queue_divisibility, enqueue, init_queue
+from moco_tpu.models import ProjectionHead, create_resnet
+from moco_tpu.ops.losses import cross_entropy, infonce_logits, l2_normalize, topk_accuracy
+from moco_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from moco_tpu.parallel.shuffle import (
+    make_permutation,
+    ring_shift,
+    ring_unshift,
+    shuffle_gather,
+    unshuffle_gather,
+)
+from moco_tpu.utils.config import MocoConfig, TrainConfig
+
+
+class MoCoEncoder(nn.Module):
+    """backbone + projection head = the reference's `base_encoder(num_classes=dim)`
+    with optional MLP surgery (`moco/builder.py:~L20-30`), composed explicitly."""
+
+    backbone: nn.Module
+    head: nn.Module
+
+    def __call__(self, x, train: bool = True):
+        return self.head(self.backbone(x, train=train))
+
+
+def build_encoder(cfg: MocoConfig, num_data: Optional[int] = None) -> MoCoEncoder:
+    dtype = jnp.dtype(cfg.compute_dtype)
+    syncbn_axis = DATA_AXIS if cfg.shuffle == "syncbn" else None
+    groups = None
+    if syncbn_axis and cfg.syncbn_group_size and num_data:
+        # Subgrouped SyncBN — the detection configs' "per-8-GPU" statistics
+        # pattern (Base-RCNN-C4-BN.yaml) via axis_index_groups.
+        g = cfg.syncbn_group_size
+        if num_data % g:
+            raise ValueError(f"data axis {num_data} not divisible by syncbn group {g}")
+        groups = [list(range(i, i + g)) for i in range(0, num_data, g)]
+    backbone = create_resnet(
+        cfg.arch,
+        cifar_stem=cfg.cifar_stem,
+        dtype=dtype,
+        bn_cross_replica_axis=syncbn_axis,
+        bn_axis_index_groups=groups,
+    )
+    head = ProjectionHead(dim=cfg.dim, mlp=cfg.mlp, dtype=dtype)
+    return MoCoEncoder(backbone=backbone, head=head)
+
+
+class MocoState(struct.PyTreeNode):
+    """Everything `main_moco.py`'s checkpoint carries (SURVEY.md §3.5):
+    both encoders, queue + pointer, optimizer state, step."""
+
+    step: jax.Array
+    params_q: Any
+    params_k: Any
+    batch_stats_q: Any
+    batch_stats_k: Any
+    queue: jax.Array  # (K, dim) rows; L2-normalized
+    queue_ptr: jax.Array  # int32 scalar
+    opt_state: Any
+
+
+def create_state(
+    rng: jax.Array,
+    config: TrainConfig,
+    encoder: MoCoEncoder,
+    tx,
+    sample_input: jax.Array,
+) -> MocoState:
+    p_rng, q_rng = jax.random.split(rng)
+    variables = encoder.init(p_rng, sample_input, train=False)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    cfg = config.moco
+    queue = (
+        init_queue(q_rng, cfg.num_negatives, cfg.dim)
+        if cfg.num_negatives > 0
+        else jnp.zeros((0, cfg.dim), jnp.float32)
+    )
+    return MocoState(
+        step=jnp.zeros((), jnp.int32),
+        params_q=params,
+        # key encoder initialized as a copy of the query encoder
+        # (moco/builder.py:~L32-36)
+        params_k=jax.tree.map(jnp.copy, params),
+        batch_stats_q=batch_stats,
+        batch_stats_k=jax.tree.map(jnp.copy, batch_stats),
+        queue=queue,
+        queue_ptr=jnp.zeros((), jnp.int32),
+        opt_state=tx.init(params),
+    )
+
+
+def state_specs(shard_queue_over_model: bool) -> MocoState:
+    """PartitionSpec pytree for MocoState: everything replicated except,
+    optionally, the queue rows sharded over the model axis (tensor
+    parallelism for very large dictionaries)."""
+    qspec = P(MODEL_AXIS, None) if shard_queue_over_model else P()
+    return MocoState(
+        step=P(),
+        params_q=P(),
+        params_k=P(),
+        batch_stats_q=P(),
+        batch_stats_k=P(),
+        queue=qspec,
+        queue_ptr=P(),
+        opt_state=P(),
+    )
+
+
+def make_train_step(
+    config: TrainConfig,
+    encoder: MoCoEncoder,
+    tx,
+    mesh: Mesh,
+    shard_queue_over_model: Optional[bool] = None,
+    donate: bool = False,
+) -> Callable:
+    """Builds the jitted SPMD train step over `mesh`.
+
+    batch: {'im_q': (B_global,H,W,C), 'im_k': ...} fp32, already augmented
+    (host- or device-side); sharded over the `data` axis.
+    """
+    cfg = config.moco
+    n_data = mesh.shape[DATA_AXIS]
+    n_model = mesh.shape.get(MODEL_AXIS, 1)
+    global_batch = config.data.global_batch
+    if global_batch % n_data:
+        raise ValueError(f"global batch {global_batch} not divisible by data axis {n_data}")
+    if cfg.num_negatives:
+        check_queue_divisibility(cfg.num_negatives, global_batch)
+    if shard_queue_over_model is None:
+        shard_queue_over_model = n_model > 1 and cfg.num_negatives > 0
+    if shard_queue_over_model and cfg.num_negatives % (n_model * max(global_batch, 1)):
+        raise ValueError("sharded queue requires K % (num_model*global_batch) == 0")
+
+    def apply_encoder(params, batch_stats, x, train=True):
+        out, mut = encoder.apply(
+            {"params": params, "batch_stats": batch_stats},
+            x,
+            train=train,
+            mutable=["batch_stats"],
+        )
+        return out, mut["batch_stats"]
+
+    def step_fn(state: MocoState, batch, root_rng):
+        im_q, im_k = batch["im_q"], batch["im_k"]
+        local_b = im_q.shape[0]
+        # Deterministic per-step randomness, identical on every device:
+        # replaces the reference's `broadcast(idx_shuffle, src=0)`
+        # (moco/builder.py:~L89).
+        step_rng = jax.random.fold_in(root_rng, state.step)
+
+        # (1) EMA momentum update of the key encoder, *before* the key
+        # forward, as upstream orders it (moco/builder.py:~L139-141).
+        params_k = ema_update(state.params_k, state.params_q, cfg.momentum)
+
+        # (2) Shuffle-BN: compute keys on a batch that contains none of
+        # this device's own positives.
+        if cfg.shuffle == "gather_perm" and n_data > 1:
+            perm, inv_perm = make_permutation(step_rng, global_batch)
+            im_k_sh = shuffle_gather(im_k, perm, DATA_AXIS)
+            k_sh, stats_k = apply_encoder(params_k, state.batch_stats_k, im_k_sh)
+            k_sh = l2_normalize(k_sh)
+            k_local, k_global = unshuffle_gather(k_sh, inv_perm, DATA_AXIS)
+        elif cfg.shuffle == "ring" and n_data > 1:
+            im_k_sh = ring_shift(im_k, DATA_AXIS)
+            k_sh, stats_k = apply_encoder(params_k, state.batch_stats_k, im_k_sh)
+            k_sh = l2_normalize(k_sh)
+            k_local = ring_unshift(k_sh, DATA_AXIS)
+            k_global = lax.all_gather(k_local, DATA_AXIS).reshape(-1, cfg.dim)
+        else:  # 'syncbn' (cross-replica BN handles decorrelation) or 'none'
+            k_local, stats_k = apply_encoder(params_k, state.batch_stats_k, im_k)
+            k_local = l2_normalize(k_local)
+            k_global = (
+                lax.all_gather(k_local, DATA_AXIS).reshape(-1, cfg.dim)
+                if n_data > 1
+                else k_local
+            )
+        k_local = lax.stop_gradient(k_local)
+        k_global = lax.stop_gradient(k_global)
+
+        # (3) Query forward + InfoNCE loss (moco/builder.py:~L128-161).
+        def loss_fn(params_q):
+            q, stats_q = apply_encoder(params_q, state.batch_stats_q, im_q)
+            q = l2_normalize(q)
+            if cfg.num_negatives:
+                logits, labels = infonce_logits(q, k_local, state.queue, cfg.temperature)
+                if shard_queue_over_model:
+                    # queue rows are sharded over `model`: logits currently
+                    # hold [pos | my negative shard]; assemble full rows.
+                    l_pos, l_neg = logits[:, :1], logits[:, 1:]
+                    l_neg = lax.all_gather(l_neg, MODEL_AXIS, axis=1, tiled=True)
+                    logits = jnp.concatenate([l_pos, l_neg], axis=1)
+            else:
+                # v3-style queue-free: global batch keys are the negatives.
+                logits = q @ k_global.T / cfg.temperature
+                rank = lax.axis_index(DATA_AXIS)
+                labels = rank * local_b + jnp.arange(local_b, dtype=jnp.int32)
+            loss = cross_entropy(logits, labels)
+            return loss, (stats_q, logits, labels)
+
+        (loss, (stats_q, logits, labels)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params_q
+        )
+
+        # (4) Gradient + metric reduction over data (DDP all-reduce equiv).
+        # With a model-sharded queue the backward of the MODEL-axis
+        # all_gather is a reduce-scatter: shard m's grads carry only (M x)
+        # its own negative shard's contribution, so they must also be
+        # pmean'd over MODEL — the factor M cancels exactly, restoring the
+        # replicated-params invariant.
+        grad_axes = (DATA_AXIS, MODEL_AXIS) if shard_queue_over_model else DATA_AXIS
+        grads = lax.pmean(grads, grad_axes)
+        metrics = {"loss": loss, **topk_accuracy(logits, labels)}
+        metrics = lax.pmean(metrics, DATA_AXIS)
+        # Running BN stats: average across devices (strictly better than
+        # the reference, which checkpoints rank 0's local stats).
+        stats_q = lax.pmean(stats_q, DATA_AXIS)
+        stats_k = lax.pmean(stats_k, DATA_AXIS)
+
+        # (5) Optimizer update (replicated, identical on all devices).
+        updates, opt_state = tx.update(grads, state.opt_state, state.params_q)
+        params_q = optax.apply_updates(state.params_q, updates)
+
+        # (6) FIFO enqueue of the global key batch
+        # (moco/builder.py:~L62-77); with a model-sharded queue each shard
+        # writes only the rows that fall inside it.
+        if cfg.num_negatives:
+            if shard_queue_over_model:
+                shard_rows = cfg.num_negatives // n_model
+                m_rank = lax.axis_index(MODEL_AXIS)
+                offset = m_rank * shard_rows
+                local_ptr = state.queue_ptr - offset
+                in_range = (local_ptr >= 0) & (local_ptr + global_batch <= shard_rows)
+                safe_ptr = jnp.clip(local_ptr, 0, shard_rows - global_batch)
+                written, _ = enqueue(state.queue, safe_ptr, k_global)
+                queue = jnp.where(in_range, written, state.queue)
+                queue_ptr = (state.queue_ptr + global_batch) % cfg.num_negatives
+            else:
+                queue, queue_ptr = enqueue(state.queue, state.queue_ptr, k_global)
+        else:
+            queue, queue_ptr = state.queue, state.queue_ptr
+
+        new_state = MocoState(
+            step=state.step + 1,
+            params_q=params_q,
+            params_k=params_k,
+            batch_stats_q=stats_q,
+            batch_stats_k=stats_k,
+            queue=queue,
+            queue_ptr=queue_ptr,
+            opt_state=opt_state,
+        )
+        return new_state, metrics
+
+    specs = state_specs(shard_queue_over_model)
+    batch_spec = {"im_q": P(DATA_AXIS), "im_k": P(DATA_AXIS)}
+    sharded = jax.shard_map(
+        step_fn,
+        mesh=mesh,
+        in_specs=(specs, batch_spec, P()),
+        out_specs=(specs, P()),
+        check_vma=False,
+    )
+    # Explicit in/out shardings matter: letting jit infer them from a
+    # SingleDeviceSharding initial state makes every later call re-lay-out
+    # the whole state (~120ms per step through the axon tunnel, measured).
+    # Callers should `place_state` the initial state onto the mesh.
+    to_sharding = lambda tree: jax.tree.map(
+        lambda s: NamedSharding(mesh, s), tree, is_leaf=lambda x: isinstance(x, P)
+    )
+    state_shardings = to_sharding(specs)
+    jit_kwargs = dict(
+        in_shardings=(state_shardings, to_sharding(batch_spec), NamedSharding(mesh, P())),
+        out_shardings=(state_shardings, NamedSharding(mesh, P())),
+    )
+    # Donation halves peak state memory but is pathologically slow through
+    # the axon remote-TPU tunnel (~80ms/call fixed cost, measured); state
+    # buffers are small relative to HBM, so it stays opt-in.
+    if donate:
+        jit_kwargs["donate_argnums"] = 0
+    return jax.jit(sharded, **jit_kwargs)
+
+
+def place_state(state: MocoState, mesh: Mesh, shard_queue_over_model: bool = False) -> MocoState:
+    """device_put the state into the mesh shardings the train step expects."""
+    specs = state_specs(shard_queue_over_model)
+    placed = {}
+    for name in state.__dataclass_fields__:
+        spec = getattr(specs, name)
+        sharding = NamedSharding(mesh, spec)
+        placed[name] = jax.tree.map(lambda x: jax.device_put(x, sharding), getattr(state, name))
+    return MocoState(**placed)

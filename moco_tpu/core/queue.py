@@ -1,0 +1,48 @@
+"""The negative-key FIFO dictionary.
+
+Reference: `moco/builder.py:~L38-42` registers `queue = randn(dim, K)`
+(L2-normalized columns) and `queue_ptr`; `_dequeue_and_enqueue`
+(`~L62-77`) all-gathers the step's keys across ranks, asserts
+`K % batch == 0`, writes them at `ptr`, and advances `ptr` modulo K.
+
+TPU-native redesign: the queue is a `(K, dim)` row-major array carried in
+the train state (replicated sharding), updated with
+`lax.dynamic_update_slice` *inside* the jitted step — no host round-trip,
+no mutable buffer. Because `K % global_batch == 0` the write never wraps,
+so a single dynamic slice suffices (same invariant as the reference).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from moco_tpu.ops.losses import l2_normalize
+
+
+def init_queue(rng: jax.Array, num_negatives: int, dim: int) -> jax.Array:
+    """Random L2-normalized rows, like the reference's normalized randn."""
+    q = jax.random.normal(rng, (num_negatives, dim), dtype=jnp.float32)
+    return l2_normalize(q, axis=-1)
+
+
+def enqueue(queue: jax.Array, ptr: jax.Array, keys: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """FIFO write of a (N, dim) key block at ptr; returns (queue, new_ptr).
+
+    Requires K % N == 0 (checked statically by the caller /
+    `check_queue_divisibility`), mirroring the reference's
+    `assert self.K % batch_size == 0`.
+    """
+    num_neg = queue.shape[0]
+    keys = jax.lax.stop_gradient(keys).astype(queue.dtype)
+    queue = jax.lax.dynamic_update_slice(queue, keys, (ptr, jnp.zeros_like(ptr)))
+    new_ptr = (ptr + keys.shape[0]) % num_neg
+    return queue, new_ptr
+
+
+def check_queue_divisibility(num_negatives: int, global_batch: int) -> None:
+    if num_negatives % global_batch != 0:
+        raise ValueError(
+            f"queue size K={num_negatives} must be divisible by the global batch "
+            f"{global_batch} (reference invariant, moco/builder.py:~L70)"
+        )

@@ -1,0 +1,8 @@
+from moco_tpu.ops.losses import (
+    cross_entropy,
+    infonce_logits,
+    l2_normalize,
+    topk_accuracy,
+)
+
+__all__ = ["cross_entropy", "infonce_logits", "l2_normalize", "topk_accuracy"]

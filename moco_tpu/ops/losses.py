@@ -1,0 +1,57 @@
+"""Loss and metric primitives: L2-normalize, InfoNCE logits, stable CE, top-k.
+
+Reference semantics being reproduced:
+- `q = nn.functional.normalize(q, dim=1)` (`moco/builder.py:~L135,~L146`)
+- InfoNCE logits: `l_pos = einsum('nc,nc->n', q, k)`,
+  `l_neg = einsum('nc,ck->nk', q, queue)`, concat, `/= T`, labels all zero
+  (`moco/builder.py:~L150-159`); loss is `nn.CrossEntropyLoss` in the
+  driver (`main_moco.py:~L185`).
+- `accuracy(output, target, topk=(1,5))` proxy metric (`main_moco.py:~L377-395`).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def l2_normalize(x: jax.Array, axis: int = -1, eps: float = 1e-12) -> jax.Array:
+    """Matches torch.nn.functional.normalize: x / max(||x||, eps)."""
+    norm = jnp.linalg.norm(x, axis=axis, keepdims=True)
+    return x / jnp.maximum(norm, eps)
+
+
+def infonce_logits(
+    q: jax.Array,  # (N, C) L2-normalized queries
+    k: jax.Array,  # (N, C) L2-normalized positive keys (stop-gradient'd by caller)
+    queue: jax.Array,  # (K, C) negative keys
+    temperature: float,
+) -> tuple[jax.Array, jax.Array]:
+    """Returns ((N, 1+K) logits, (N,) int labels == 0).
+
+    The positive is column 0, negatives follow — exactly the reference's
+    `cat([l_pos, l_neg], dim=1)` layout, so label vectors and the top-k
+    proxy metric are directly comparable.
+    """
+    k = jax.lax.stop_gradient(k)
+    queue = jax.lax.stop_gradient(queue)
+    l_pos = jnp.einsum("nc,nc->n", q, k)[:, None]
+    l_neg = jnp.einsum("nc,kc->nk", q, queue)
+    logits = jnp.concatenate([l_pos, l_neg], axis=1) / temperature
+    labels = jnp.zeros(q.shape[0], dtype=jnp.int32)
+    return logits, labels
+
+
+def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean softmax cross-entropy with integer labels (stable log-softmax)."""
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    true_logit = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+    return jnp.mean(logz - true_logit)
+
+
+def topk_accuracy(logits: jax.Array, labels: jax.Array, ks=(1, 5)) -> dict[str, jax.Array]:
+    """Top-k accuracy in percent, as the reference's `accuracy()` reports."""
+    max_k = max(ks)
+    _, top_idx = jax.lax.top_k(logits, max_k)  # (N, max_k)
+    correct = top_idx == labels[:, None]
+    return {f"acc{k}": 100.0 * jnp.mean(jnp.any(correct[:, :k], axis=1)) for k in ks}

@@ -1,0 +1,29 @@
+from moco_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    batch_sharding,
+    create_mesh,
+    replicated_sharding,
+    shard_batch,
+)
+from moco_tpu.parallel.shuffle import (
+    make_permutation,
+    ring_shift,
+    ring_unshift,
+    shuffle_gather,
+    unshuffle_gather,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "batch_sharding",
+    "create_mesh",
+    "replicated_sharding",
+    "shard_batch",
+    "make_permutation",
+    "ring_shift",
+    "ring_unshift",
+    "shuffle_gather",
+    "unshuffle_gather",
+]

@@ -1,0 +1,60 @@
+"""Device-mesh construction — the TPU replacement for the reference's
+process-group world (`main_moco.py:~L70-85, ~L150`: NCCL
+`init_process_group`, one process per GPU).
+
+A single `jax.sharding.Mesh` covers every scale the reference reaches
+(and beyond): 1 chip, one ICI slice, or multi-slice/multi-host over DCN —
+the rank/world-size/dist-url machinery disappears into mesh axes. The
+default is a 1-D `data` axis (the reference is data-parallel only,
+SURVEY.md §2.3); an optional `model` axis shards the negative queue and
+the InfoNCE logits matmul for very large dictionaries.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def create_mesh(
+    num_data: Optional[int] = None,
+    num_model: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a (data[, model]) mesh over the available devices.
+
+    `num_data=None` uses all devices (divided by `num_model`). On real
+    TPU slices `jax.devices()` is already ordered so contiguous
+    model-axis groups ride ICI neighbors.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if num_data is None:
+        if len(devices) % num_model:
+            raise ValueError(f"{len(devices)} devices not divisible by model={num_model}")
+        num_data = len(devices) // num_model
+    n = num_data * num_model
+    if n > len(devices):
+        raise ValueError(f"need {n} devices, have {len(devices)}")
+    arr = np.array(devices[:n]).reshape(num_data, num_model)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch dimension sharded over the data axis, rest replicated."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh: Mesh, batch):
+    """Device_put a host batch with the leading dim sharded over `data`."""
+    s = batch_sharding(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, s), batch)

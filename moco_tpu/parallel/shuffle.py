@@ -1,0 +1,88 @@
+"""Cross-replica batch shuffling (Shuffle-BN) — TPU-native redesigns.
+
+Reference: `moco/builder.py:~L79-126` (`_batch_shuffle_ddp` /
+`_batch_unshuffle_ddp`, "*** Only support DDP model. ***"). There, rank 0
+draws a random permutation of the global key batch and *broadcasts* it
+over NCCL; every rank all-gathers the images, takes its permuted slice,
+runs `encoder_k` with per-GPU BatchNorm, and the embeddings are
+all-gathered back and inverse-permuted. Purpose: per-device BN statistics
+must not contain a query's own positive key (the BN "cheating" signature
+leak).
+
+TPU-native redesigns (all used inside `shard_map` over the `data` axis):
+
+1. `gather_perm` (reference-exact semantics): the broadcast is replaced
+   by *deterministic same-seed randomness* — every replica computes the
+   identical permutation from the replicated step RNG, so no collective
+   is needed to agree on it. Data still moves via `all_gather` exactly as
+   upstream.
+
+2. `ring` (cheaper, same leak-prevention guarantee): a `ppermute` ring
+   shift by one — device d computes keys for device d+1's batch, so no
+   device ever normalizes a batch containing its own queries' positives.
+   Two point-to-point ICI hops total (images out, embeddings back)
+   instead of two all-gathers.
+
+A third alternative — no shuffle, subgroup cross-replica BN (SyncBN, as
+the reference's detection configs use) — lives in the model's
+`bn_cross_replica_axis` knob, not here.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _merge_gather(x: jax.Array, axis_name: str) -> jax.Array:
+    """all_gather with the device dim folded into the batch dim: (N_global, ...)."""
+    g = lax.all_gather(x, axis_name)  # (n_dev, B_local, ...)
+    return g.reshape((-1,) + g.shape[2:])
+
+
+def make_permutation(rng: jax.Array, global_batch: int) -> tuple[jax.Array, jax.Array]:
+    """(perm, inv_perm) for the global batch. Called with a *replicated* rng
+    inside the step so every device computes the same permutation —
+    deterministic seeding replaces the reference's `broadcast(src=0)`."""
+    perm = jax.random.permutation(rng, global_batch)
+    inv_perm = jnp.argsort(perm)
+    return perm, inv_perm
+
+
+def shuffle_gather(x: jax.Array, perm: jax.Array, axis_name: str) -> jax.Array:
+    """Give this device the rows `perm[rank*B:(rank+1)*B]` of the global batch."""
+    local_b = x.shape[0]
+    rank = lax.axis_index(axis_name)
+    x_all = _merge_gather(x, axis_name)
+    my_rows = lax.dynamic_slice_in_dim(perm, rank * local_b, local_b)
+    return jnp.take(x_all, my_rows, axis=0)
+
+
+def unshuffle_gather(
+    k: jax.Array, inv_perm: jax.Array, axis_name: str
+) -> tuple[jax.Array, jax.Array]:
+    """Invert `shuffle_gather` on the key embeddings.
+
+    Returns (k_local, k_global): this device's keys in original order, and
+    the full global key batch in original order (reused for the queue
+    update, saving the reference's third all_gather in
+    `_dequeue_and_enqueue`).
+    """
+    local_b = k.shape[0]
+    rank = lax.axis_index(axis_name)
+    k_all = _merge_gather(k, axis_name)  # rows in perm order
+    k_global = jnp.take(k_all, inv_perm, axis=0)  # original order
+    k_local = lax.dynamic_slice_in_dim(k_global, rank * local_b, local_b)
+    return k_local, k_global
+
+
+def ring_shift(x: jax.Array, axis_name: str, shift: int = 1) -> jax.Array:
+    """Send this device's batch to rank+shift (mod n) over the ICI ring."""
+    n = lax.axis_size(axis_name)
+    pairs = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, pairs)
+
+
+def ring_unshift(x: jax.Array, axis_name: str, shift: int = 1) -> jax.Array:
+    return ring_shift(x, axis_name, shift=-shift)

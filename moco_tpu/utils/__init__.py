@@ -1,0 +1,20 @@
+from moco_tpu.utils.config import (
+    DataConfig,
+    MocoConfig,
+    OptimConfig,
+    ParallelConfig,
+    PRESETS,
+    TrainConfig,
+)
+from moco_tpu.utils.schedules import build_optimizer, make_lr_schedule
+
+__all__ = [
+    "DataConfig",
+    "MocoConfig",
+    "OptimConfig",
+    "ParallelConfig",
+    "PRESETS",
+    "TrainConfig",
+    "build_optimizer",
+    "make_lr_schedule",
+]

@@ -1,0 +1,115 @@
+"""Dataclass config system.
+
+Replaces the reference's argparse blocks duplicated across
+`main_moco.py:~L30-100` and `main_lincls.py:~L30-95`. Field names and
+defaults mirror the reference flags (`--moco-dim 128 --moco-k 65536
+--moco-m 0.999 --moco-t 0.07`, `--lr 0.03`, `--schedule 120 160`, v2
+switches `--mlp --aug-plus --cos --moco-t 0.2`). Presets correspond to
+BASELINE.json's config list.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class MocoConfig:
+    arch: str = "resnet50"
+    dim: int = 128  # --moco-dim
+    num_negatives: int = 65536  # --moco-k
+    momentum: float = 0.999  # --moco-m
+    temperature: float = 0.07  # --moco-t (0.2 for v2 recipe)
+    mlp: bool = False  # --mlp (v2)
+    # BN decorrelation strategy: 'gather_perm' (reference-exact Shuffle-BN),
+    # 'ring' (ppermute shift), 'syncbn' (subgroup cross-replica BN, no shuffle),
+    # 'none' (single-device / ablation).
+    shuffle: str = "gather_perm"
+    syncbn_group_size: int = 0  # 0 = whole data axis, else subgroups of this size
+    cifar_stem: bool = False
+    compute_dtype: str = "bfloat16"
+    # MoCo v3 (queue-free symmetric contrastive): set num_negatives=0,
+    # v3=True adds the prediction head.
+    v3: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimConfig:
+    optimizer: str = "sgd"  # sgd | lars | adamw
+    lr: float = 0.03
+    momentum: float = 0.9
+    weight_decay: float = 1e-4
+    cos: bool = False  # cosine schedule (--cos)
+    schedule: Tuple[int, ...] = (120, 160)  # step-decay epochs (--schedule)
+    warmup_epochs: int = 0
+    epochs: int = 200
+    # LARS extras for the pod-scale large-batch config
+    trust_coefficient: float = 0.001
+
+
+@dataclasses.dataclass(frozen=True)
+class DataConfig:
+    dataset: str = "synthetic"  # synthetic | cifar10 | imagefolder
+    data_dir: Optional[str] = None
+    image_size: int = 224
+    global_batch: int = 256
+    aug_plus: bool = False  # v2 aug recipe (jitter+blur), main_moco.py:~L225-255
+    num_workers: int = 4
+    on_device_augment: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelConfig:
+    num_data: Optional[int] = None  # None = all devices
+    num_model: int = 1  # shards the queue/logits for very large K
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    moco: MocoConfig = dataclasses.field(default_factory=MocoConfig)
+    optim: OptimConfig = dataclasses.field(default_factory=OptimConfig)
+    data: DataConfig = dataclasses.field(default_factory=DataConfig)
+    parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
+    seed: int = 0
+    workdir: str = "/tmp/moco_tpu"
+    log_every: int = 10  # --print-freq
+    checkpoint_every_epochs: int = 1
+    steps_per_epoch: Optional[int] = None  # None = derive from dataset size
+
+
+def _v2(moco: MocoConfig, **kw) -> MocoConfig:
+    return dataclasses.replace(moco, mlp=True, temperature=0.2, **kw)
+
+
+PRESETS = {
+    # BASELINE.json configs[0]: single-process CPU/1-chip smoke
+    "cifar_smoke": TrainConfig(
+        moco=MocoConfig(arch="resnet18", num_negatives=4096, cifar_stem=True, shuffle="none"),
+        optim=OptimConfig(lr=0.03, epochs=10, cos=True),
+        data=DataConfig(dataset="cifar10", image_size=32, global_batch=256),
+    ),
+    # configs[1]: ImageNet-100 v2
+    "imagenet100_v2": TrainConfig(
+        moco=_v2(MocoConfig()),
+        optim=OptimConfig(lr=0.03, epochs=200, cos=True),
+        data=DataConfig(dataset="imagefolder", aug_plus=True),
+    ),
+    # configs[2]: ImageNet-1k v2 200ep, 8-chip DP
+    "imagenet_v2": TrainConfig(
+        moco=_v2(MocoConfig()),
+        optim=OptimConfig(lr=0.03, epochs=200, cos=True),
+        data=DataConfig(dataset="imagefolder", aug_plus=True),
+    ),
+    # configs[3]: pod-scale large-batch + LARS (v4-128-class)
+    "imagenet_v2_large_batch": TrainConfig(
+        moco=_v2(MocoConfig()),
+        optim=OptimConfig(
+            optimizer="lars", lr=4.8, weight_decay=1e-6, epochs=200, cos=True, warmup_epochs=10
+        ),
+        data=DataConfig(dataset="imagefolder", aug_plus=True, global_batch=4096),
+    ),
+}
+# BASELINE.json configs[4] (MoCo v3 ViT-B/16 queue-free) is added to
+# PRESETS by moco_tpu.models.vit when the v3 path lands — a preset must
+# never name an arch the factory can't build.

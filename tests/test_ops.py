@@ -1,0 +1,92 @@
+"""Numpy-oracle tests for loss/metric primitives (SURVEY.md §4's
+recommended unit strategy — the reference itself has no tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from moco_tpu.core.ema import ema_update
+from moco_tpu.core.queue import check_queue_divisibility, enqueue, init_queue
+from moco_tpu.ops import cross_entropy, infonce_logits, l2_normalize, topk_accuracy
+import pytest
+
+
+def test_l2_normalize_matches_torch_semantics():
+    x = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+    got = np.asarray(l2_normalize(jnp.asarray(x)))
+    want = x / np.maximum(np.linalg.norm(x, axis=1, keepdims=True), 1e-12)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    # zero row does not produce NaN (torch normalize semantics)
+    z = np.asarray(l2_normalize(jnp.zeros((1, 8))))
+    assert np.all(np.isfinite(z))
+
+
+def test_infonce_logits_oracle():
+    rs = np.random.RandomState(1)
+    q = rs.randn(6, 16).astype(np.float32)
+    k = rs.randn(6, 16).astype(np.float32)
+    queue = rs.randn(32, 16).astype(np.float32)
+    T = 0.07
+    logits, labels = infonce_logits(jnp.asarray(q), jnp.asarray(k), jnp.asarray(queue), T)
+    want_pos = np.sum(q * k, axis=1, keepdims=True)
+    want_neg = q @ queue.T
+    np.testing.assert_allclose(np.asarray(logits), np.concatenate([want_pos, want_neg], 1) / T, rtol=2e-5)
+    assert np.all(np.asarray(labels) == 0)
+
+
+def test_infonce_no_grad_through_keys_or_queue():
+    q = jnp.ones((2, 4))
+    k = jnp.ones((2, 4))
+    queue = jnp.ones((8, 4))
+
+    def loss_wrt_k(k):
+        logits, labels = infonce_logits(q, k, queue, 0.1)
+        return cross_entropy(logits, labels)
+
+    assert np.allclose(jax.grad(loss_wrt_k)(k), 0.0)
+
+
+def test_cross_entropy_oracle():
+    rs = np.random.RandomState(2)
+    logits = rs.randn(5, 7).astype(np.float32) * 3
+    labels = rs.randint(0, 7, 5)
+    p = np.exp(logits - logits.max(1, keepdims=True))
+    p /= p.sum(1, keepdims=True)
+    want = -np.mean(np.log(p[np.arange(5), labels]))
+    got = cross_entropy(jnp.asarray(logits), jnp.asarray(labels))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_topk_accuracy():
+    logits = jnp.asarray([[3.0, 2.0, 1.0, 0.0], [0.0, 1.0, 2.0, 3.0]])
+    labels = jnp.asarray([0, 0])
+    acc = topk_accuracy(logits, labels, ks=(1, 3))
+    assert acc["acc1"] == 50.0
+    assert acc["acc3"] == 50.0  # second row: label 0 ranks 4th
+
+
+def test_ema_matches_numpy():
+    k = {"w": jnp.asarray([1.0, 2.0]), "b": jnp.asarray(4.0)}
+    q = {"w": jnp.asarray([3.0, 0.0]), "b": jnp.asarray(0.0)}
+    out = ema_update(k, q, 0.9)
+    np.testing.assert_allclose(out["w"], [1.0 * 0.9 + 0.3, 2.0 * 0.9])
+    np.testing.assert_allclose(out["b"], 3.6)
+
+
+def test_queue_fifo_and_wraparound():
+    queue = init_queue(jax.random.key(0), 8, 4)
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(queue), axis=1), 1.0, rtol=1e-5)
+    ptr = jnp.zeros((), jnp.int32)
+    blocks = [jnp.full((4, 4), float(i)) for i in range(3)]
+    for b in blocks:
+        queue, ptr = enqueue(queue, ptr, b)
+    # after 3 writes of 4 into K=8: ptr wrapped to 4; rows 0-3 = block2, 4-7 = block1
+    assert int(ptr) == 4
+    np.testing.assert_allclose(np.asarray(queue)[:4], 2.0)
+    np.testing.assert_allclose(np.asarray(queue)[4:], 1.0)
+
+
+def test_queue_divisibility_guard():
+    check_queue_divisibility(4096, 256)
+    with pytest.raises(ValueError):
+        check_queue_divisibility(65536, 100)

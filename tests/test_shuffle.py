@@ -1,0 +1,92 @@
+"""Shuffle-BN collective patterns on the 8-virtual-device mesh:
+inverse property, cross-device movement, and determinism (the properties
+the reference gets from NCCL broadcast + all_gather, moco/builder.py:~L79-126)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from moco_tpu.parallel import (
+    DATA_AXIS,
+    create_mesh,
+    make_permutation,
+    ring_shift,
+    ring_unshift,
+    shuffle_gather,
+    unshuffle_gather,
+)
+
+
+def _mesh():
+    return create_mesh(num_data=8, num_model=1)
+
+
+def test_shuffle_unshuffle_is_identity():
+    mesh = _mesh()
+    x = jnp.arange(16 * 3, dtype=jnp.float32).reshape(16, 3)
+
+    def f(x, rng):
+        perm, inv = make_permutation(rng, 16)
+        x_sh = shuffle_gather(x, perm, DATA_AXIS)
+        # pretend-encode: identity, so unshuffle must reconstruct x
+        local, global_ = unshuffle_gather(x_sh, inv, DATA_AXIS)
+        return local, global_
+
+    local, global_ = jax.jit(
+        jax.shard_map(
+            f, mesh=mesh, in_specs=(P(DATA_AXIS), P()), out_specs=(P(DATA_AXIS), P()), check_vma=False
+        )
+    )(x, jax.random.key(3))
+    np.testing.assert_array_equal(np.asarray(local), np.asarray(x))
+    np.testing.assert_array_equal(np.asarray(global_), np.asarray(x))
+
+
+def test_shuffle_actually_permutes():
+    mesh = _mesh()
+    x = jnp.arange(16, dtype=jnp.float32).reshape(16, 1)
+
+    def f(x, rng):
+        perm, _ = make_permutation(rng, 16)
+        return shuffle_gather(x, perm, DATA_AXIS)
+
+    shuffled = jax.jit(
+        jax.shard_map(f, mesh=mesh, in_specs=(P(DATA_AXIS), P()), out_specs=P(DATA_AXIS), check_vma=False)
+    )(x, jax.random.key(0))
+    assert not np.array_equal(np.asarray(shuffled), np.asarray(x))
+    assert sorted(np.asarray(shuffled).ravel().tolist()) == list(range(16))
+
+
+def test_ring_shift_moves_whole_batches_and_inverts():
+    mesh = _mesh()
+    # row value encodes source device: device d holds rows [2d, 2d+1]
+    x = jnp.repeat(jnp.arange(8, dtype=jnp.float32), 2).reshape(16, 1)
+
+    def f(x):
+        y = ring_shift(x, DATA_AXIS)
+        rank = jax.lax.axis_index(DATA_AXIS)
+        # leak-prevention guarantee: nothing in my shifted batch is mine
+        not_mine = jnp.all(y != rank.astype(jnp.float32))
+        back = ring_unshift(y, DATA_AXIS)
+        return y, back, jnp.reshape(not_mine, (1,))
+
+    y, back, not_mine = jax.jit(
+        jax.shard_map(
+            f,
+            mesh=mesh,
+            in_specs=P(DATA_AXIS),
+            out_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+            check_vma=False,
+        )
+    )(x)
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(x))
+    assert np.all(np.asarray(not_mine))
+    # shifted by one device: device d now holds device (d-1... d+1)'s rows
+    assert not np.array_equal(np.asarray(y), np.asarray(x))
+
+
+def test_permutation_is_deterministic_per_seed():
+    p1, i1 = make_permutation(jax.random.key(7), 32)
+    p2, _ = make_permutation(jax.random.key(7), 32)
+    np.testing.assert_array_equal(np.asarray(p1), np.asarray(p2))
+    np.testing.assert_array_equal(np.asarray(p1)[np.asarray(i1)], np.arange(32))
