@@ -17,13 +17,14 @@ DDP all-reduce, `SURVEY.md §3.1`):
 - shuffle='gather_perm': 2× all_gather (images, embeddings; the
   broadcast is replaced by same-seed randomness, and the queue reuses
   the unshuffle gather — one collective fewer than upstream)
-- shuffle='ring': 2× ppermute + 1× small all_gather
+- shuffle='a2a': 2× all_to_all + 1× small all_gather (balanced random
+  permutation — moves (n-1)/n of the batch over ICI vs the full
+  n× batch an all_gather moves)
 - 1× psum for gradients (the DDP bucketed all-reduce equivalent)
 """
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable, Optional
 
 import flax.linen as nn
@@ -40,9 +41,9 @@ from moco_tpu.models import ProjectionHead, create_resnet
 from moco_tpu.ops.losses import cross_entropy, infonce_logits, l2_normalize, topk_accuracy
 from moco_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 from moco_tpu.parallel.shuffle import (
+    balanced_shuffle,
+    balanced_unshuffle,
     make_permutation,
-    ring_shift,
-    ring_unshift,
     shuffle_gather,
     unshuffle_gather,
 )
@@ -64,6 +65,11 @@ def build_encoder(cfg: MocoConfig, num_data: Optional[int] = None) -> MoCoEncode
     dtype = jnp.dtype(cfg.compute_dtype)
     syncbn_axis = DATA_AXIS if cfg.shuffle == "syncbn" else None
     groups = None
+    if syncbn_axis and cfg.syncbn_group_size and num_data is None:
+        raise ValueError(
+            "syncbn_group_size is set but build_encoder was called without "
+            "num_data — subgrouped SyncBN needs the data-axis size to form groups"
+        )
     if syncbn_axis and cfg.syncbn_group_size and num_data:
         # Subgrouped SyncBN — the detection configs' "per-8-GPU" statistics
         # pattern (Base-RCNN-C4-BN.yaml) via axis_index_groups.
@@ -199,11 +205,11 @@ def make_train_step(
             k_sh, stats_k = apply_encoder(params_k, state.batch_stats_k, im_k_sh)
             k_sh = l2_normalize(k_sh)
             k_local, k_global = unshuffle_gather(k_sh, inv_perm, DATA_AXIS)
-        elif cfg.shuffle == "ring" and n_data > 1:
-            im_k_sh = ring_shift(im_k, DATA_AXIS)
+        elif cfg.shuffle == "a2a" and n_data > 1:
+            im_k_sh = balanced_shuffle(step_rng, im_k, DATA_AXIS)
             k_sh, stats_k = apply_encoder(params_k, state.batch_stats_k, im_k_sh)
             k_sh = l2_normalize(k_sh)
-            k_local = ring_unshift(k_sh, DATA_AXIS)
+            k_local = balanced_unshuffle(step_rng, k_sh, DATA_AXIS)
             k_global = lax.all_gather(k_local, DATA_AXIS).reshape(-1, cfg.dim)
         else:  # 'syncbn' (cross-replica BN handles decorrelation) or 'none'
             k_local, stats_k = apply_encoder(params_k, state.batch_stats_k, im_k)

@@ -1,0 +1,44 @@
+from moco_tpu.data.augment import (
+    AugRecipe,
+    V1_RECIPE,
+    V2_RECIPE,
+    apply_recipe,
+    center_crop,
+    color_jitter,
+    gaussian_blur,
+    get_recipe,
+    normalize,
+    random_grayscale,
+    random_horizontal_flip,
+    random_resized_crop,
+    two_crop_augment,
+)
+from moco_tpu.data.datasets import (
+    Cifar10Dataset,
+    ImageFolderDataset,
+    SyntheticDataset,
+    build_dataset,
+)
+from moco_tpu.data.pipeline import EvalPipeline, TwoCropPipeline
+
+__all__ = [
+    "AugRecipe",
+    "V1_RECIPE",
+    "V2_RECIPE",
+    "apply_recipe",
+    "center_crop",
+    "color_jitter",
+    "gaussian_blur",
+    "get_recipe",
+    "normalize",
+    "random_grayscale",
+    "random_horizontal_flip",
+    "random_resized_crop",
+    "two_crop_augment",
+    "Cifar10Dataset",
+    "ImageFolderDataset",
+    "SyntheticDataset",
+    "build_dataset",
+    "EvalPipeline",
+    "TwoCropPipeline",
+]

@@ -1,0 +1,143 @@
+"""Host-side dataset sources.
+
+The reference reads `torchvision.datasets.ImageFolder` through a
+32-worker `DataLoader` (`main_moco.py:~L255-260`, SURVEY.md §3.4). Here a
+dataset is just an indexable source of raw images (uint8 HWC) + labels;
+decode/resize runs in a thread pool (PIL releases the GIL for JPEG
+decode), and all stochastic augmentation happens on-device
+(`moco_tpu.data.augment`).
+
+Sources:
+- `SyntheticDataset` — deterministic random images; CI / bench / smoke.
+- `Cifar10Dataset` — the standard python-pickle batches from a local
+  directory (no network in this environment; torchvision's downloader is
+  deliberately not reproduced).
+- `ImageFolderDataset` — class-per-subdirectory layout, identical
+  semantics to torchvision ImageFolder (sorted class names → indices).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Optional, Sequence
+
+import numpy as np
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".webp")
+
+
+class SyntheticDataset:
+    """Fixed-seed random uint8 images; index-deterministic so tests can
+    rely on reproducibility without holding the whole set in memory."""
+
+    def __init__(self, num_examples: int = 1024, image_size: int = 224, num_classes: int = 10):
+        self.num_examples = num_examples
+        self.image_size = image_size
+        self.num_classes = num_classes
+
+    def __len__(self) -> int:
+        return self.num_examples
+
+    def load(self, index: int, decode_size: Optional[int] = None) -> tuple[np.ndarray, int]:
+        size = decode_size or self.image_size
+        rng = np.random.default_rng(index)
+        img = rng.integers(0, 256, (size, size, 3), dtype=np.uint8)
+        return img, int(index % self.num_classes)
+
+
+class Cifar10Dataset:
+    """CIFAR-10 from the standard `cifar-10-batches-py` pickle files."""
+
+    def __init__(self, data_dir: str, train: bool = True):
+        batch_dir = data_dir
+        if os.path.isdir(os.path.join(data_dir, "cifar-10-batches-py")):
+            batch_dir = os.path.join(data_dir, "cifar-10-batches-py")
+        names = [f"data_batch_{i}" for i in range(1, 6)] if train else ["test_batch"]
+        images, labels = [], []
+        for name in names:
+            path = os.path.join(batch_dir, name)
+            if not os.path.exists(path):
+                raise FileNotFoundError(
+                    f"{path} not found — provide the standard cifar-10-batches-py "
+                    "directory (no network access to download it)"
+                )
+            with open(path, "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            images.append(d[b"data"])
+            labels.extend(d[b"labels"])
+        data = np.concatenate(images).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        self.images = np.ascontiguousarray(data)  # uint8 NHWC
+        self.labels = np.asarray(labels, np.int32)
+
+    def __len__(self) -> int:
+        return len(self.images)
+
+    def load(self, index: int, decode_size: Optional[int] = None) -> tuple[np.ndarray, int]:
+        return self.images[index], int(self.labels[index])
+
+
+class ImageFolderDataset:
+    """`root/class_x/img.jpg` layout; classes sorted alphabetically, as
+    torchvision ImageFolder assigns indices."""
+
+    def __init__(self, root: str, decode_size: int = 256):
+        self.root = root
+        self.decode_size = decode_size
+        classes = sorted(
+            d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d))
+        )
+        if not classes:
+            raise ValueError(f"no class subdirectories under {root}")
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples: list[tuple[str, int]] = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fname in sorted(os.listdir(cdir)):
+                if fname.lower().endswith(IMG_EXTENSIONS):
+                    self.samples.append((os.path.join(cdir, fname), self.class_to_idx[c]))
+        if not self.samples:
+            raise ValueError(f"no images under {root}")
+
+    def __len__(self) -> int:
+        return len(self.samples)
+
+    def load(self, index: int, decode_size: Optional[int] = None) -> tuple[np.ndarray, int]:
+        from PIL import Image
+
+        path, label = self.samples[index]
+        size = decode_size or self.decode_size
+        with Image.open(path) as im:
+            im = im.convert("RGB")
+            # Shortest-side resize to `size` on the host; random-resized-crop
+            # then runs on-device from this canvas. (The crop-scale window it
+            # sees differs from cropping the original only for extreme
+            # aspect ratios.)
+            w, h = im.size
+            s = size / min(w, h)
+            im = im.resize((max(size, round(w * s)), max(size, round(h * s))))
+            arr = np.asarray(im, np.uint8)
+        # Center-crop the long side to a square canvas of fixed shape so
+        # batches stack.
+        h, w, _ = arr.shape
+        y0, x0 = (h - size) // 2, (w - size) // 2
+        return arr[y0 : y0 + size, x0 : x0 + size], label
+
+
+def build_dataset(name: str, data_dir: Optional[str], image_size: int, train: bool = True):
+    if name == "synthetic":
+        return SyntheticDataset(image_size=max(image_size, 32))
+    if name == "cifar10":
+        if data_dir is None:
+            raise ValueError("cifar10 needs data_dir")
+        return Cifar10Dataset(data_dir, train=train)
+    if name == "imagefolder":
+        if data_dir is None:
+            raise ValueError("imagefolder needs data_dir")
+        split = "train" if train else "val"
+        root = data_dir
+        if os.path.isdir(os.path.join(data_dir, split)):
+            root = os.path.join(data_dir, split)
+        # decode canvas ~1.146x the crop (256 for 224-crops, the standard ratio)
+        return ImageFolderDataset(root, decode_size=round(image_size * 256 / 224))
+    raise ValueError(f"unknown dataset {name!r}")

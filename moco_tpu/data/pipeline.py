@@ -1,0 +1,154 @@
+"""Input pipeline: host decode → device two-crop augment → prefetch.
+
+Replaces the reference's `DataLoader(workers=32)` + `TwoCropsTransform`
+(`main_moco.py:~L255-260`, `moco/loader.py`). Split of labor:
+
+- host threads: index shuffling (per-epoch, seeded — the
+  `DistributedSampler.set_epoch` equivalent), image decode to a fixed
+  uint8 canvas, batch stacking;
+- device: ALL stochastic augmentation, batched and jitted
+  (`moco_tpu.data.augment.two_crop_augment`), producing {'im_q','im_k'}
+  already sharded over the mesh's data axis;
+- a depth-2 prefetch queue overlaps host decode with the train step.
+
+drop_last=True semantics (reference DataLoader) — the queue's
+`K % global_batch == 0` invariant requires full batches.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from moco_tpu.data.augment import AugRecipe, get_recipe, two_crop_augment
+from moco_tpu.data.datasets import build_dataset
+from moco_tpu.parallel.mesh import DATA_AXIS
+from moco_tpu.utils.config import DataConfig
+
+
+class TwoCropPipeline:
+    """Iterable over {'im_q','im_k'} device batches for one epoch at a time."""
+
+    def __init__(
+        self,
+        config: DataConfig,
+        mesh: Mesh,
+        seed: int = 0,
+        dataset=None,
+        train: bool = True,
+    ):
+        self.config = config
+        self.mesh = mesh
+        self.seed = seed
+        self.dataset = dataset or build_dataset(
+            config.dataset, config.data_dir, config.image_size, train=train
+        )
+        self.batch_size = config.global_batch
+        if len(self.dataset) < self.batch_size:
+            raise ValueError(
+                f"dataset of {len(self.dataset)} examples < global batch {self.batch_size}"
+            )
+        self.steps_per_epoch = len(self.dataset) // self.batch_size  # drop_last
+        self.recipe: AugRecipe = get_recipe(config.aug_plus, config.image_size)
+        self._pool = ThreadPoolExecutor(max_workers=max(config.num_workers, 1))
+        self._batch_sharding = NamedSharding(mesh, P(DATA_AXIS))
+
+        out_size = config.image_size
+        recipe = self.recipe
+
+        @jax.jit
+        def _augment(rng, raw_uint8):
+            images = raw_uint8.astype(jnp.float32) / 255.0
+            return two_crop_augment(recipe, rng, images, out_size)
+
+        self._augment = _augment
+
+    def _host_batch(self, indices: np.ndarray) -> np.ndarray:
+        loads = list(self._pool.map(self.dataset.load, indices))
+        return np.stack([img for img, _ in loads])
+
+    def epoch(self, epoch: int) -> Iterator[dict]:
+        """Shuffled epoch, seeded by (seed, epoch) — sampler.set_epoch equiv."""
+        order = np.random.default_rng((self.seed, epoch)).permutation(len(self.dataset))
+        rng = jax.random.PRNGKey(self.seed)
+        rng = jax.random.fold_in(rng, epoch)
+
+        def gen():
+            for step in range(self.steps_per_epoch):
+                idx = order[step * self.batch_size : (step + 1) * self.batch_size]
+                raw = self._host_batch(idx)
+                step_rng = jax.random.fold_in(rng, step)
+                raw = jax.device_put(raw, self._batch_sharding)
+                yield self._augment(step_rng, raw)
+
+        return _prefetch(gen(), depth=2)
+
+
+def _prefetch(it: Iterator, depth: int = 2) -> Iterator:
+    """Run the producer in a thread, keeping `depth` batches in flight."""
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    _END = object()
+
+    def producer():
+        try:
+            for item in it:
+                q.put(item)
+            q.put(_END)
+        except BaseException as e:  # surface producer errors to the consumer
+            q.put(e)
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    while True:
+        item = q.get()
+        if item is _END:
+            return
+        if isinstance(item, BaseException):
+            raise item
+        yield item
+
+
+class EvalPipeline:
+    """Deterministic center-crop batches with labels, for the linear probe
+    (`main_lincls.py` val transform: Resize(256), CenterCrop(224))."""
+
+    def __init__(self, config: DataConfig, mesh: Mesh, train: bool = False, dataset=None):
+        self.config = config
+        self.dataset = dataset or build_dataset(
+            config.dataset, config.data_dir, config.image_size, train=train
+        )
+        self.batch_size = config.global_batch
+        self.steps = len(self.dataset) // self.batch_size
+        self.mesh = mesh
+        self._sharding = NamedSharding(mesh, P(DATA_AXIS))
+        self._pool = ThreadPoolExecutor(max_workers=max(config.num_workers, 1))
+
+    def __iter__(self):
+        from moco_tpu.data.augment import get_recipe, normalize
+
+        recipe = get_recipe(self.config.aug_plus, self.config.image_size)
+
+        def gen():
+            for step in range(self.steps):
+                idx = np.arange(step * self.batch_size, (step + 1) * self.batch_size)
+                loads = list(self._pool.map(self.dataset.load, idx))
+                raw = np.stack([img for img, _ in loads])
+                labels = np.asarray([l for _, l in loads], np.int32)
+                x = jnp.asarray(raw, jnp.float32) / 255.0
+                if x.shape[1] != self.config.image_size:
+                    y0 = (x.shape[1] - self.config.image_size) // 2
+                    x = x[:, y0 : y0 + self.config.image_size, y0 : y0 + self.config.image_size]
+                x = normalize(x, recipe.mean, recipe.std)
+                yield (
+                    jax.device_put(x, self._sharding),
+                    jax.device_put(jnp.asarray(labels), self._sharding),
+                )
+
+        return _prefetch(gen(), depth=2)

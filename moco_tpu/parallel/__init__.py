@@ -8,8 +8,8 @@ from moco_tpu.parallel.mesh import (
 )
 from moco_tpu.parallel.shuffle import (
     make_permutation,
-    ring_shift,
-    ring_unshift,
+    balanced_shuffle,
+    balanced_unshuffle,
     shuffle_gather,
     unshuffle_gather,
 )
@@ -22,8 +22,8 @@ __all__ = [
     "replicated_sharding",
     "shard_batch",
     "make_permutation",
-    "ring_shift",
-    "ring_unshift",
+    "balanced_shuffle",
+    "balanced_unshuffle",
     "shuffle_gather",
     "unshuffle_gather",
 ]

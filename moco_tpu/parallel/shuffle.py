@@ -17,11 +17,17 @@ TPU-native redesigns (all used inside `shard_map` over the `data` axis):
    is needed to agree on it. Data still moves via `all_gather` exactly as
    upstream.
 
-2. `ring` (cheaper, same leak-prevention guarantee): a `ppermute` ring
-   shift by one — device d computes keys for device d+1's batch, so no
-   device ever normalizes a batch containing its own queries' positives.
-   Two point-to-point ICI hops total (images out, embeddings back)
-   instead of two all-gathers.
+2. `a2a` (cheaper, statistically equivalent decorrelation): a *balanced
+   random permutation* — local permutation, `all_to_all` chunk exchange,
+   local permutation. Every device's key batch then contains a random
+   B/n-sized slice from each device, so the positive key is normalized
+   with (in expectation) only 1/n of its own co-batch — the same
+   expected composition a uniform global permutation gives — while
+   moving only (n-1)/n of the batch over ICI instead of a full
+   all_gather. (An earlier `ring` mode that ppermuted batches *intact*
+   was removed: moving an unchanged batch to another device leaves BN
+   statistics bit-identical to no shuffle at all — composition, not
+   device identity, is what leaks.)
 
 A third alternative — no shuffle, subgroup cross-replica BN (SyncBN, as
 the reference's detection configs use) — lives in the model's
@@ -77,12 +83,37 @@ def unshuffle_gather(
     return k_local, k_global
 
 
-def ring_shift(x: jax.Array, axis_name: str, shift: int = 1) -> jax.Array:
-    """Send this device's batch to rank+shift (mod n) over the ICI ring."""
+def _local_perms(rng: jax.Array, local_b: int, axis_name: str) -> tuple[jax.Array, jax.Array]:
+    """Per-device (pre, post) permutations of the local batch, derived from
+    the replicated step rng + the device's rank."""
+    rank = lax.axis_index(axis_name)
+    pre = jax.random.permutation(jax.random.fold_in(jax.random.fold_in(rng, 17), rank), local_b)
+    post = jax.random.permutation(jax.random.fold_in(jax.random.fold_in(rng, 29), rank), local_b)
+    return pre, post
+
+
+def balanced_shuffle(rng: jax.Array, x: jax.Array, axis_name: str) -> jax.Array:
+    """Random *balanced* permutation of the global batch: each device ends
+    up with a random B/n-slice from every device.
+
+    local-perm → tiled all_to_all (device d's chunk j → device j) →
+    local-perm. Requires local batch divisible by the axis size."""
     n = lax.axis_size(axis_name)
-    pairs = [(i, (i + shift) % n) for i in range(n)]
-    return lax.ppermute(x, axis_name, pairs)
+    b = x.shape[0]
+    if b % n:
+        raise ValueError(f"a2a shuffle needs local batch {b} divisible by axis size {n}")
+    pre, post = _local_perms(rng, b, axis_name)
+    x = jnp.take(x, pre, axis=0)
+    x = lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0, tiled=True)
+    return jnp.take(x, post, axis=0)
 
 
-def ring_unshift(x: jax.Array, axis_name: str, shift: int = 1) -> jax.Array:
-    return ring_shift(x, axis_name, shift=-shift)
+def balanced_unshuffle(rng: jax.Array, y: jax.Array, axis_name: str) -> jax.Array:
+    """Exact inverse of `balanced_shuffle` with the same rng (the tiled
+    chunk exchange is an involution; the local perms invert via argsort)."""
+    n = lax.axis_size(axis_name)
+    b = y.shape[0]
+    pre, post = _local_perms(rng, b, axis_name)
+    y = jnp.take(y, jnp.argsort(post), axis=0)
+    y = lax.all_to_all(y, axis_name, split_axis=0, concat_axis=0, tiled=True)
+    return jnp.take(y, jnp.argsort(pre), axis=0)

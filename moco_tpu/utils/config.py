@@ -23,7 +23,7 @@ class MocoConfig:
     temperature: float = 0.07  # --moco-t (0.2 for v2 recipe)
     mlp: bool = False  # --mlp (v2)
     # BN decorrelation strategy: 'gather_perm' (reference-exact Shuffle-BN),
-    # 'ring' (ppermute shift), 'syncbn' (subgroup cross-replica BN, no shuffle),
+    # 'a2a' (balanced all_to_all permutation), 'syncbn' (subgroup cross-replica BN, no shuffle),
     # 'none' (single-device / ablation).
     shuffle: str = "gather_perm"
     syncbn_group_size: int = 0  # 0 = whole data axis, else subgroups of this size

@@ -1,0 +1,204 @@
+"""Data-layer tests: augmentation semantics vs numpy oracles, recipe
+composition, two-crop independence, and the host pipeline."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from moco_tpu.data import (
+    SyntheticDataset,
+    TwoCropPipeline,
+    V1_RECIPE,
+    V2_RECIPE,
+    apply_recipe,
+    color_jitter,
+    gaussian_blur,
+    get_recipe,
+    normalize,
+    random_grayscale,
+    random_horizontal_flip,
+    random_resized_crop,
+    two_crop_augment,
+)
+from moco_tpu.data.augment import (
+    adjust_brightness,
+    adjust_contrast,
+    adjust_hue,
+    adjust_saturation,
+)
+from moco_tpu.parallel import create_mesh
+from moco_tpu.utils.config import DataConfig
+
+RNG = jax.random.PRNGKey(0)
+
+
+def rand_images(b=4, s=16):
+    return jax.random.uniform(jax.random.PRNGKey(7), (b, s, s, 3))
+
+
+class TestColorOps:
+    def test_brightness_zero_is_black(self):
+        img = rand_images()
+        out = adjust_brightness(img, jnp.zeros((4, 1, 1, 1)))
+        assert jnp.allclose(out, 0.0)
+
+    def test_brightness_identity(self):
+        img = rand_images()
+        out = adjust_brightness(img, jnp.ones((4, 1, 1, 1)))
+        np.testing.assert_allclose(out, img, atol=1e-6)
+
+    def test_contrast_one_identity(self):
+        img = rand_images()
+        np.testing.assert_allclose(
+            adjust_contrast(img, jnp.ones((4, 1, 1, 1))), img, atol=1e-6
+        )
+
+    def test_saturation_zero_is_gray(self):
+        img = rand_images()
+        out = adjust_saturation(img, jnp.zeros((4, 1, 1, 1)))
+        assert jnp.allclose(out[..., 0], out[..., 1], atol=1e-6)
+        assert jnp.allclose(out[..., 1], out[..., 2], atol=1e-6)
+
+    def test_hue_zero_identity(self):
+        img = rand_images()
+        np.testing.assert_allclose(
+            adjust_hue(img, jnp.zeros((4, 1, 1, 1))), img, atol=1e-5
+        )
+
+    def test_hue_full_turn_identity(self):
+        img = rand_images()
+        # delta=1.0 is a full rotation of the chroma plane
+        np.testing.assert_allclose(
+            adjust_hue(img, jnp.ones((4, 1, 1, 1))), img, atol=1e-4
+        )
+
+    def test_jitter_range(self):
+        out = color_jitter(RNG, rand_images(), 0.4, 0.4, 0.4, 0.1)
+        assert out.shape == (4, 16, 16, 3)
+        assert float(out.min()) >= 0.0 and float(out.max()) <= 1.0
+
+    def test_jitter_apply_prob_zero_identity(self):
+        img = rand_images()
+        out = color_jitter(RNG, img, 0.4, 0.4, 0.4, 0.1, apply_prob=0.0)
+        np.testing.assert_allclose(out, img, atol=1e-6)
+
+
+class TestGeometric:
+    def test_flip_prob_one(self):
+        img = rand_images()
+        out = random_horizontal_flip(RNG, img, prob=1.0)
+        np.testing.assert_allclose(out, img[:, :, ::-1, :])
+
+    def test_flip_prob_zero(self):
+        img = rand_images()
+        np.testing.assert_allclose(random_horizontal_flip(RNG, img, prob=0.0), img)
+
+    def test_crop_identity_when_full_scale(self):
+        """scale=(1,1), ratio=(1,1) on square input = resize-only ≈ identity."""
+        img = rand_images(2, 16)
+        out = random_resized_crop(RNG, img, 16, scale=(1.0, 1.0), ratio=(1.0, 1.0))
+        np.testing.assert_allclose(out, img, atol=1e-3)
+
+    def test_crop_output_shape_and_range(self):
+        img = rand_images(3, 32)
+        out = random_resized_crop(RNG, img, 16)
+        assert out.shape == (3, 16, 16, 3)
+        assert bool(jnp.isfinite(out).all())
+        assert float(out.min()) >= -1e-4 and float(out.max()) <= 1 + 1e-4
+
+    def test_crops_differ_across_batch(self):
+        img = jnp.broadcast_to(rand_images(1, 32), (4, 32, 32, 3))
+        out = random_resized_crop(RNG, img, 16)
+        assert not jnp.allclose(out[0], out[1])
+
+
+class TestBlurGray:
+    def test_grayscale_prob_one(self):
+        out = random_grayscale(RNG, rand_images(), prob=1.0)
+        assert jnp.allclose(out[..., 0], out[..., 2], atol=1e-6)
+
+    def test_blur_matches_scipy_oracle(self):
+        from scipy.ndimage import gaussian_filter
+
+        img = np.asarray(rand_images(1, 16))
+        sigma = 1.3
+        out = gaussian_blur(
+            RNG, jnp.asarray(img), sigma_range=(sigma, sigma), apply_prob=1.0, taps=13
+        )
+        want = np.stack(
+            [gaussian_filter(img[0, ..., c], sigma, mode="nearest", truncate=6.0 / sigma)
+             for c in range(3)],
+            axis=-1,
+        )
+        np.testing.assert_allclose(np.asarray(out[0]), want, atol=5e-3)
+
+    def test_blur_preserves_mean_roughly(self):
+        img = rand_images(2, 16)
+        out = gaussian_blur(RNG, img, apply_prob=1.0)
+        np.testing.assert_allclose(jnp.mean(out), jnp.mean(img), atol=0.02)
+
+
+class TestRecipes:
+    def test_two_crops_differ_and_shapes(self):
+        img = rand_images(4, 32)
+        views = two_crop_augment(V2_RECIPE, RNG, img, 16)
+        assert views["im_q"].shape == (4, 16, 16, 3)
+        assert not jnp.allclose(views["im_q"], views["im_k"])
+
+    def test_recipe_deterministic_in_rng(self):
+        img = rand_images(2, 32)
+        a = apply_recipe(V1_RECIPE, RNG, img, 16)
+        b = apply_recipe(V1_RECIPE, RNG, img, 16)
+        np.testing.assert_allclose(a, b)
+
+    def test_normalize_stats(self):
+        x = jnp.ones((1, 4, 4, 3)) * 0.5
+        out = normalize(x, (0.5, 0.5, 0.5), (0.25, 0.25, 0.25))
+        np.testing.assert_allclose(out, 0.0, atol=1e-6)
+
+    def test_small_image_recipe_drops_blur(self):
+        r = get_recipe(aug_plus=True, image_size=32)
+        assert r.blur_prob == 0.0
+        assert get_recipe(aug_plus=True, image_size=224).blur_prob == 0.5
+
+    def test_recipes_jit_compile(self):
+        img = rand_images(2, 32)
+        fn = jax.jit(lambda r, x: apply_recipe(V2_RECIPE, r, x, 16))
+        out = fn(RNG, img)
+        assert bool(jnp.isfinite(out).all())
+
+
+class TestPipeline:
+    def test_two_crop_pipeline_epoch(self):
+        mesh = create_mesh()
+        cfg = DataConfig(dataset="synthetic", image_size=16, global_batch=16, num_workers=2)
+        pipe = TwoCropPipeline(cfg, mesh, seed=0)
+        batches = list(pipe.epoch(0))
+        assert len(batches) == pipe.steps_per_epoch == 1024 // 16
+        b = batches[0]
+        assert b["im_q"].shape == (16, 16, 16, 3)
+        assert not jnp.allclose(b["im_q"], b["im_k"])
+
+    def test_epoch_shuffling_differs(self):
+        mesh = create_mesh()
+        cfg = DataConfig(dataset="synthetic", image_size=16, global_batch=16, num_workers=2)
+        pipe = TwoCropPipeline(cfg, mesh, seed=0)
+        b0 = next(iter(pipe.epoch(0)))
+        b1 = next(iter(pipe.epoch(1)))
+        assert not jnp.allclose(b0["im_q"], b1["im_q"])
+
+    def test_batch_sharded_over_data_axis(self):
+        mesh = create_mesh()
+        cfg = DataConfig(dataset="synthetic", image_size=16, global_batch=16, num_workers=2)
+        b = next(iter(TwoCropPipeline(cfg, mesh).epoch(0)))
+        n = mesh.shape["data"]
+        assert len(b["im_q"].addressable_shards) == jax.device_count()
+        assert b["im_q"].addressable_shards[0].data.shape[0] == 16 // n
+
+    def test_synthetic_dataset_deterministic(self):
+        ds = SyntheticDataset(64, 16)
+        a, la = ds.load(3)
+        b, lb = ds.load(3)
+        np.testing.assert_array_equal(a, b)
+        assert la == lb

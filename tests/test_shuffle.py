@@ -9,10 +9,10 @@ from jax.sharding import PartitionSpec as P
 
 from moco_tpu.parallel import (
     DATA_AXIS,
+    balanced_shuffle,
+    balanced_unshuffle,
     create_mesh,
     make_permutation,
-    ring_shift,
-    ring_unshift,
     shuffle_gather,
     unshuffle_gather,
 )
@@ -57,20 +57,24 @@ def test_shuffle_actually_permutes():
     assert sorted(np.asarray(shuffled).ravel().tolist()) == list(range(16))
 
 
-def test_ring_shift_moves_whole_batches_and_inverts():
+def test_balanced_shuffle_mixes_and_inverts():
+    """The property the removed `ring` mode LACKED (it moved batches
+    intact, leaving BN batch composition — and therefore the BN leak —
+    identical to no shuffle): every device's shuffled batch must mix
+    sources, and unshuffle must be an exact inverse."""
     mesh = _mesh()
-    # row value encodes source device: device d holds rows [2d, 2d+1]
-    x = jnp.repeat(jnp.arange(8, dtype=jnp.float32), 2).reshape(16, 1)
+    # row value encodes source device: device d holds rows valued d
+    x = jnp.repeat(jnp.arange(8, dtype=jnp.float32), 8).reshape(64, 1)
+    rng = jax.random.key(5)
 
     def f(x):
-        y = ring_shift(x, DATA_AXIS)
-        rank = jax.lax.axis_index(DATA_AXIS)
-        # leak-prevention guarantee: nothing in my shifted batch is mine
-        not_mine = jnp.all(y != rank.astype(jnp.float32))
-        back = ring_unshift(y, DATA_AXIS)
-        return y, back, jnp.reshape(not_mine, (1,))
+        y = balanced_shuffle(rng, x, DATA_AXIS)
+        # balanced: exactly local_b/n rows from each source device
+        counts = jnp.stack([jnp.sum(y == d) for d in range(8)])
+        back = balanced_unshuffle(rng, y, DATA_AXIS)
+        return y, back, counts[None]
 
-    y, back, not_mine = jax.jit(
+    y, back, counts = jax.jit(
         jax.shard_map(
             f,
             mesh=mesh,
@@ -80,9 +84,28 @@ def test_ring_shift_moves_whole_batches_and_inverts():
         )
     )(x)
     np.testing.assert_array_equal(np.asarray(back), np.asarray(x))
-    assert np.all(np.asarray(not_mine))
-    # shifted by one device: device d now holds device (d-1... d+1)'s rows
+    # each device got exactly one row from every source device
+    np.testing.assert_array_equal(np.asarray(counts), np.ones((8, 8)))
     assert not np.array_equal(np.asarray(y), np.asarray(x))
+
+
+def test_balanced_shuffle_changes_per_device_statistics():
+    """Regression for the ring-mode bug: per-device batch *statistics*
+    (what BN sees) must change under the shuffle."""
+    mesh = _mesh()
+    x = jax.random.normal(jax.random.key(0), (64, 4))
+
+    def f(x):
+        y = balanced_shuffle(jax.random.key(1), x, DATA_AXIS)
+        return jnp.mean(x, 0, keepdims=True), jnp.mean(y, 0, keepdims=True)
+
+    mx, my = jax.jit(
+        jax.shard_map(
+            f, mesh=mesh, in_specs=P(DATA_AXIS), out_specs=(P(DATA_AXIS), P(DATA_AXIS)), check_vma=False
+        )
+    )(x)
+    # per-device means of the shuffled batch differ from the unshuffled ones
+    assert not np.allclose(np.asarray(mx), np.asarray(my), atol=1e-6)
 
 
 def test_permutation_is_deterministic_per_seed():
