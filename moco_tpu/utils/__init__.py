@@ -7,8 +7,17 @@ from moco_tpu.utils.config import (
     TrainConfig,
 )
 from moco_tpu.utils.schedules import build_optimizer, make_lr_schedule
+from moco_tpu.utils.checkpoint import CheckpointManager, restore_best, save_best
+from moco_tpu.utils.metrics import AverageMeter, MetricWriter, ProgressMeter, profiler_trace
 
 __all__ = [
+    "AverageMeter",
+    "CheckpointManager",
+    "MetricWriter",
+    "ProgressMeter",
+    "profiler_trace",
+    "restore_best",
+    "save_best",
     "DataConfig",
     "MocoConfig",
     "OptimConfig",

@@ -1,0 +1,132 @@
+"""Checkpoint save/restore (Orbax).
+
+Reference semantics (`main_moco.py:~L195-215, ~L275-280, ~L312-320`,
+SURVEY.md §3.5): rank-0 saves `checkpoint_{epoch:04d}.pth.tar` every
+epoch with `{'epoch','arch','state_dict','optimizer'}`; `state_dict`
+carries both encoders, the queue + pointer, so `--resume` restores the
+EMA encoder and the negative dictionary exactly. The linear probe
+additionally keeps a `model_best` snapshot (`main_lincls.py:~L250-260`).
+
+TPU-native redesign: the whole `MocoState` pytree (params_q, params_k,
+batch_stats, queue, queue_ptr, opt_state, step) plus the root data RNG
+and epoch counter is one Orbax StandardSave — multi-host-safe (Orbax
+coordinates per-host shard writes; the reference needed the rank-0-only
+dance), atomic (tmp dir + rename), with keep-last-N garbage collection
+and an optional `best` alias for probe drivers.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+
+class CheckpointManager:
+    """Thin wrapper over `orbax.checkpoint.CheckpointManager` that
+    checkpoints an arbitrary state pytree keyed by step/epoch."""
+
+    def __init__(self, directory: str, keep: int = 3, save_interval: int = 1):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=keep,
+                save_interval_steps=save_interval,
+                create=True,
+                enable_async_checkpointing=False,
+            ),
+        )
+
+    def save(self, step: int, state: Any, extra: Optional[dict] = None, force: bool = False) -> None:
+        """Blocking save of the state pytree + JSON-serializable extras.
+        `force=True` bypasses the save-interval policy (used for the final
+        epoch, which an interval of N would otherwise silently skip)."""
+        extra = _jsonify(extra or {})
+        self._mgr.save(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardSave(state), extra=ocp.args.JsonSave(extra)
+            ),
+            force=force,
+        )
+        self._mgr.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def read_extra(self, step: Optional[int] = None) -> dict:
+        """Restore only the JSON extras (no state template needed) — lets
+        tools discover the training config before building a restore
+        template."""
+        step = step if step is not None else self._mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {self.directory}")
+        restored = self._mgr.restore(step, args=ocp.args.Composite(extra=ocp.args.JsonRestore()))
+        return dict(restored["extra"] or {})
+
+    def restore(self, abstract_state: Any, step: Optional[int] = None) -> tuple[Any, dict]:
+        """Restore into the structure/shardings of `abstract_state`.
+
+        `abstract_state` may be a concrete pytree (freshly created state):
+        its shape/dtype/sharding guide the restore, exactly the
+        `load_state_dict` pattern of the reference's `--resume`.
+        """
+        step = step if step is not None else self._mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {self.directory}")
+        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, abstract_state)
+        restored = self._mgr.restore(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(abstract), extra=ocp.args.JsonRestore()
+            ),
+        )
+        return restored["state"], dict(restored["extra"] or {})
+
+    def close(self) -> None:
+        self._mgr.close()
+
+
+def _jsonify(extra: dict) -> dict:
+    out = {}
+    for k, v in extra.items():
+        if isinstance(v, (np.ndarray, jax.Array)):
+            out[k] = np.asarray(v).tolist()
+        elif isinstance(v, (np.integer, np.floating)):
+            out[k] = v.item()
+        else:
+            out[k] = v
+    return out
+
+
+def save_best(directory: str, state: Any, metric: float) -> None:
+    """`model_best` alias (`main_lincls.py:~L250-260`): overwrite the
+    single best-by-metric snapshot."""
+    path = os.path.join(os.path.abspath(directory), "best")
+    with ocp.Checkpointer(ocp.CompositeCheckpointHandler()) as ckptr:
+        ckptr.save(
+            path,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardSave(state),
+                extra=ocp.args.JsonSave({"metric": float(metric)}),
+            ),
+            force=True,
+        )
+
+
+def restore_best(directory: str, abstract_state: Any) -> tuple[Any, float]:
+    path = os.path.join(os.path.abspath(directory), "best")
+    abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, abstract_state)
+    with ocp.Checkpointer(ocp.CompositeCheckpointHandler()) as ckptr:
+        out = ckptr.restore(
+            path,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(abstract), extra=ocp.args.JsonRestore()
+            ),
+        )
+    return out["state"], float(out["extra"]["metric"])

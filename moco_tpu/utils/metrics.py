@@ -1,0 +1,97 @@
+"""Training metrics: meters, progress display, JSONL writer, profiler.
+
+Reference: `AverageMeter` / `ProgressMeter` (`main_moco.py:~L322-360`)
+print `Epoch: [e][i/n] Time ... Data ... Loss ... Acc@1 ... Acc@5 ...`
+every `--print-freq` steps; non-master ranks are silenced
+(`main_moco.py:~L145`). There is no structured logging in the reference
+(SURVEY.md §5.5) — the JSONL writer and `jax.profiler` hook here are the
+TPU-native observability upgrade (§5.1).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+from typing import Optional
+
+import jax
+
+
+class AverageMeter:
+    """Running value/average, formatted like the reference's meter."""
+
+    def __init__(self, name: str, fmt: str = ":f"):
+        self.name, self.fmt = name, fmt
+        self.reset()
+
+    def reset(self) -> None:
+        self.val = self.sum = self.count = 0.0
+
+    def update(self, val: float, n: int = 1) -> None:
+        self.val = float(val)
+        self.sum += float(val) * n
+        self.count += n
+
+    @property
+    def avg(self) -> float:
+        return self.sum / max(self.count, 1)
+
+    def __str__(self) -> str:
+        return ("{name} {val" + self.fmt + "} ({avg" + self.fmt + "})").format(
+            name=self.name, val=self.val, avg=self.avg
+        )
+
+
+class ProgressMeter:
+    """`Epoch: [e][ i/n] <meters>` lines, as `main_moco.py:~L340-360`."""
+
+    def __init__(self, num_batches: int, meters: list[AverageMeter], prefix: str = ""):
+        num_digits = len(str(num_batches))
+        self.batch_fmtstr = "[{:" + str(num_digits) + "d}/" + str(num_batches) + "]"
+        self.meters = meters
+        self.prefix = prefix
+
+    def display(self, batch: int) -> str:
+        entries = [self.prefix + self.batch_fmtstr.format(batch)]
+        entries += [str(m) for m in self.meters]
+        line = "\t".join(entries)
+        print(line, flush=True)
+        return line
+
+
+class MetricWriter:
+    """Append-only JSONL metrics (one object per log event) + stdout."""
+
+    def __init__(self, workdir: str, filename: str = "metrics.jsonl"):
+        os.makedirs(workdir, exist_ok=True)
+        self.path = os.path.join(workdir, filename)
+        self._f = open(self.path, "a", buffering=1)
+
+    def write(self, step: int, payload: dict) -> None:
+        rec = {"step": int(step), "time": time.time()}
+        rec.update(
+            {
+                k: (float(v) if hasattr(v, "__float__") else v)
+                for k, v in payload.items()
+            }
+        )
+        self._f.write(json.dumps(rec) + "\n")
+
+    def close(self) -> None:
+        self._f.close()
+
+
+@contextlib.contextmanager
+def profiler_trace(logdir: Optional[str]):
+    """`jax.profiler` trace (TensorBoard-viewable) around a code region;
+    no-op when logdir is None."""
+    if not logdir:
+        yield
+        return
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,76 @@
+"""Checkpoint round-trip: full MocoState (queue, EMA, opt_state) +
+resume semantics, the rebuild's answer to `--resume` (SURVEY.md §3.5)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from moco_tpu.core import build_encoder, create_state
+from moco_tpu.utils.checkpoint import CheckpointManager, restore_best, save_best
+from moco_tpu.utils.config import DataConfig, MocoConfig, OptimConfig, TrainConfig
+from moco_tpu.utils.schedules import build_optimizer
+
+
+@pytest.fixture(scope="module")
+def small_state():
+    config = TrainConfig(
+        moco=MocoConfig(
+            arch="resnet18", dim=16, num_negatives=64, cifar_stem=True,
+            shuffle="none", compute_dtype="float32",
+        ),
+        optim=OptimConfig(lr=0.03, epochs=2),
+        data=DataConfig(dataset="synthetic", image_size=16, global_batch=8),
+    )
+    encoder = build_encoder(config.moco)
+    tx = build_optimizer(config.optim, steps_per_epoch=4)
+    state = create_state(
+        jax.random.PRNGKey(0), config, encoder, tx, jnp.zeros((1, 16, 16, 3))
+    )
+    return state
+
+
+def _assert_trees_equal(a, b):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_roundtrip_preserves_full_state(tmp_path, small_state):
+    state = small_state.replace(
+        step=jnp.asarray(7, jnp.int32),
+        queue_ptr=jnp.asarray(16, jnp.int32),
+    )
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), keep=2)
+    mgr.save(1, state, extra={"epoch": 1, "rng": np.asarray([1, 2], np.uint32)})
+    restored, extra = mgr.restore(small_state)
+    _assert_trees_equal(state, restored)
+    assert extra["epoch"] == 1
+    assert int(restored.queue_ptr) == 16
+    mgr.close()
+
+
+def test_keep_last_n_and_latest(tmp_path, small_state):
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), keep=2)
+    for e in (1, 2, 3):
+        mgr.save(e, small_state, extra={"epoch": e})
+    assert mgr.latest_step() == 3
+    _, extra = mgr.restore(small_state)
+    assert extra["epoch"] == 3
+    # step 1 should have been garbage-collected
+    with pytest.raises(Exception):
+        mgr.restore(small_state, step=1)
+    mgr.close()
+
+
+def test_restore_errors_when_empty(tmp_path, small_state):
+    mgr = CheckpointManager(str(tmp_path / "empty"))
+    with pytest.raises(FileNotFoundError):
+        mgr.restore(small_state)
+    mgr.close()
+
+
+def test_best_snapshot(tmp_path, small_state):
+    save_best(str(tmp_path), small_state, metric=61.25)
+    restored, metric = restore_best(str(tmp_path), small_state)
+    _assert_trees_equal(small_state, restored)
+    assert metric == 61.25

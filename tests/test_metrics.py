@@ -1,0 +1,34 @@
+"""Meters + JSONL writer (reference `AverageMeter`/`ProgressMeter`,
+`main_moco.py:~L322-360`)."""
+
+import json
+
+from moco_tpu.utils.metrics import AverageMeter, MetricWriter, ProgressMeter
+
+
+def test_average_meter_matches_reference_semantics():
+    m = AverageMeter("Loss", ":.4e")
+    m.update(2.0, n=2)
+    m.update(4.0, n=2)
+    assert m.val == 4.0
+    assert m.avg == 3.0
+    assert "Loss" in str(m)
+
+
+def test_progress_meter_line_format():
+    m = AverageMeter("Acc@1", ":6.2f")
+    m.update(12.5)
+    p = ProgressMeter(100, [m], prefix="Epoch: [3]")
+    line = p.display(7)
+    assert line.startswith("Epoch: [3][  7/100]")
+    assert "Acc@1" in line
+
+
+def test_metric_writer_jsonl(tmp_path):
+    w = MetricWriter(str(tmp_path))
+    w.write(5, {"loss": 1.5, "lr": 0.03})
+    w.write(10, {"loss": 1.2})
+    w.close()
+    lines = [json.loads(l) for l in open(w.path)]
+    assert lines[0]["step"] == 5 and lines[0]["loss"] == 1.5
+    assert lines[1]["step"] == 10
