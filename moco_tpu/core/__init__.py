@@ -3,6 +3,7 @@ from moco_tpu.core.moco import (
     MoCoEncoder,
     MocoState,
     build_encoder,
+    build_predictor,
     create_state,
     make_train_step,
     place_state,
@@ -15,6 +16,7 @@ __all__ = [
     "MoCoEncoder",
     "MocoState",
     "build_encoder",
+    "build_predictor",
     "create_state",
     "make_train_step",
     "place_state",

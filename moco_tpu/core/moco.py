@@ -37,7 +37,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from moco_tpu.core.ema import ema_update
 from moco_tpu.core.queue import check_queue_divisibility, enqueue, init_queue
-from moco_tpu.models import ProjectionHead, create_resnet
+from moco_tpu.models import ProjectionHead, V3MLPHead, create_resnet
 from moco_tpu.ops.losses import cross_entropy, infonce_logits, l2_normalize, topk_accuracy
 from moco_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 from moco_tpu.parallel.shuffle import (
@@ -58,11 +58,18 @@ class MoCoEncoder(nn.Module):
     head: nn.Module
 
     def __call__(self, x, train: bool = True):
-        return self.head(self.backbone(x, train=train))
+        return self.head(self.backbone(x, train=train), train=train)
 
 
-def build_encoder(cfg: MocoConfig, num_data: Optional[int] = None) -> MoCoEncoder:
+def create_backbone(cfg: MocoConfig, num_data: Optional[int] = None) -> nn.Module:
+    """Backbone factory shared by pretraining and the linear probe:
+    ResNet family or ViT family from `cfg.arch`."""
     dtype = jnp.dtype(cfg.compute_dtype)
+    if cfg.arch.startswith("vit"):
+        from moco_tpu.models.vit import create_vit
+
+        vit_kw = {"patch_size": cfg.vit_patch_size} if cfg.vit_patch_size else {}
+        return create_vit(cfg.arch, dtype=dtype, **vit_kw)
     syncbn_axis = DATA_AXIS if cfg.shuffle == "syncbn" else None
     groups = None
     if syncbn_axis and cfg.syncbn_group_size and num_data is None:
@@ -77,20 +84,47 @@ def build_encoder(cfg: MocoConfig, num_data: Optional[int] = None) -> MoCoEncode
         if num_data % g:
             raise ValueError(f"data axis {num_data} not divisible by syncbn group {g}")
         groups = [list(range(i, i + g)) for i in range(0, num_data, g)]
-    backbone = create_resnet(
+    return create_resnet(
         cfg.arch,
         cifar_stem=cfg.cifar_stem,
         dtype=dtype,
         bn_cross_replica_axis=syncbn_axis,
         bn_axis_index_groups=groups,
     )
-    head = ProjectionHead(dim=cfg.dim, mlp=cfg.mlp, dtype=dtype)
+
+
+def build_encoder(cfg: MocoConfig, num_data: Optional[int] = None) -> MoCoEncoder:
+    """Backbone + projection head. Head choice is independent of backbone
+    family: v3 gets the 3-layer SyncBN MLP (arXiv:2104.02057 — its R50
+    runs use it too), v1/v2 the reference's Linear / 2-layer MLP."""
+    dtype = jnp.dtype(cfg.compute_dtype)
+    backbone = create_backbone(cfg, num_data=num_data)
+    if cfg.v3:
+        axis = DATA_AXIS if (num_data or 1) > 1 else None
+        head = V3MLPHead(num_layers=3, dim=cfg.dim, cross_replica_axis=axis, dtype=dtype)
+    else:
+        head = ProjectionHead(dim=cfg.dim, mlp=cfg.mlp, dtype=dtype)
     return MoCoEncoder(backbone=backbone, head=head)
+
+
+def build_predictor(cfg: MocoConfig, num_data: Optional[int] = None) -> Optional[nn.Module]:
+    """v3's prediction MLP on the query side only (2-layer BN-MLP); None
+    for v1/v2, whose query and key encoders are architecturally identical."""
+    if not cfg.v3:
+        return None
+    axis = DATA_AXIS if (num_data or 1) > 1 else None
+    return V3MLPHead(
+        num_layers=2,
+        dim=cfg.dim,
+        cross_replica_axis=axis,
+        dtype=jnp.dtype(cfg.compute_dtype),
+    )
 
 
 class MocoState(struct.PyTreeNode):
     """Everything `main_moco.py`'s checkpoint carries (SURVEY.md §3.5):
-    both encoders, queue + pointer, optimizer state, step."""
+    both encoders, queue + pointer, optimizer state, step — plus, for the
+    v3 variant, the query-side prediction head (empty dicts otherwise)."""
 
     step: jax.Array
     params_q: Any
@@ -100,6 +134,8 @@ class MocoState(struct.PyTreeNode):
     queue: jax.Array  # (K, dim) rows; L2-normalized
     queue_ptr: jax.Array  # int32 scalar
     opt_state: Any
+    params_pred: Any = struct.field(default_factory=dict)
+    batch_stats_pred: Any = struct.field(default_factory=dict)
 
 
 def create_state(
@@ -108,8 +144,9 @@ def create_state(
     encoder: MoCoEncoder,
     tx,
     sample_input: jax.Array,
+    predictor: Optional[nn.Module] = None,
 ) -> MocoState:
-    p_rng, q_rng = jax.random.split(rng)
+    p_rng, q_rng, pred_rng = jax.random.split(rng, 3)
     variables = encoder.init(p_rng, sample_input, train=False)
     params = variables["params"]
     batch_stats = variables.get("batch_stats", {})
@@ -119,6 +156,11 @@ def create_state(
         if cfg.num_negatives > 0
         else jnp.zeros((0, cfg.dim), jnp.float32)
     )
+    params_pred, stats_pred = {}, {}
+    if predictor is not None:
+        pv = predictor.init(pred_rng, jnp.zeros((1, cfg.dim), jnp.float32), train=False)
+        params_pred = pv["params"]
+        stats_pred = pv.get("batch_stats", {})
     return MocoState(
         step=jnp.zeros((), jnp.int32),
         params_q=params,
@@ -129,7 +171,10 @@ def create_state(
         batch_stats_k=jax.tree.map(jnp.copy, batch_stats),
         queue=queue,
         queue_ptr=jnp.zeros((), jnp.int32),
-        opt_state=tx.init(params),
+        # one optimizer over every trainable leaf: encoder_q (+ predictor)
+        opt_state=tx.init({"enc": params, "pred": params_pred}),
+        params_pred=params_pred,
+        batch_stats_pred=stats_pred,
     )
 
 
@@ -147,6 +192,8 @@ def state_specs(shard_queue_over_model: bool) -> MocoState:
         queue=qspec,
         queue_ptr=P(),
         opt_state=P(),
+        params_pred=P(),
+        batch_stats_pred=P(),
     )
 
 
@@ -157,6 +204,8 @@ def make_train_step(
     mesh: Mesh,
     shard_queue_over_model: Optional[bool] = None,
     donate: bool = False,
+    predictor: Optional[nn.Module] = None,
+    total_steps: Optional[int] = None,
 ) -> Callable:
     """Builds the jitted SPMD train step over `mesh`.
 
@@ -164,6 +213,19 @@ def make_train_step(
     (host- or device-side); sharded over the `data` axis.
     """
     cfg = config.moco
+    if cfg.v3 and predictor is None:
+        raise ValueError("v3=True requires a predictor module (build_predictor)")
+    if cfg.v3 and cfg.num_negatives:
+        raise ValueError("v3 is queue-free: set num_negatives=0")
+    if cfg.momentum_cos and total_steps is None:
+        raise ValueError("momentum_cos=True needs total_steps for the cosine ramp")
+
+    def ema_momentum(step):
+        """Constant m, or moco-v3's cosine ramp m -> 1.0 over training."""
+        if not cfg.momentum_cos:
+            return cfg.momentum
+        frac = step.astype(jnp.float32) / total_steps
+        return 1.0 - (1.0 - cfg.momentum) * 0.5 * (1.0 + jnp.cos(jnp.pi * frac))
     n_data = mesh.shape[DATA_AXIS]
     n_model = mesh.shape.get(MODEL_AXIS, 1)
     global_batch = config.data.global_batch
@@ -185,7 +247,86 @@ def make_train_step(
         )
         return out, mut["batch_stats"]
 
+    def apply_predictor(params, batch_stats, x, train=True):
+        out, mut = predictor.apply(
+            {"params": params, "batch_stats": batch_stats},
+            x,
+            train=train,
+            mutable=["batch_stats"],
+        )
+        return out, mut["batch_stats"]
+
+    def v3_step(state: MocoState, batch):
+        """MoCo v3 (arXiv:2104.02057 alg. 1): symmetric queue-free
+        contrastive loss, both views through both encoders, the global
+        batch as negatives, 2τ loss scaling."""
+        im_q, im_k = batch["im_q"], batch["im_k"]
+        local_b = im_q.shape[0]
+        x_cat = jnp.concatenate([im_q, im_k], axis=0)
+
+        params_k = ema_update(state.params_k, state.params_q, ema_momentum(state.step))
+        k_cat, stats_k = apply_encoder(params_k, state.batch_stats_k, x_cat)
+        k1, k2 = jnp.split(lax.stop_gradient(l2_normalize(k_cat)), 2, axis=0)
+        if n_data > 1:
+            k1_g = lax.all_gather(k1, DATA_AXIS).reshape(-1, cfg.dim)
+            k2_g = lax.all_gather(k2, DATA_AXIS).reshape(-1, cfg.dim)
+            rank = lax.axis_index(DATA_AXIS)
+        else:
+            k1_g, k2_g, rank = k1, k2, 0
+        labels = rank * local_b + jnp.arange(local_b, dtype=jnp.int32)
+
+        def ctr(q, k_g):
+            logits = q @ k_g.T / cfg.temperature
+            return 2.0 * cfg.temperature * cross_entropy(logits, labels), logits
+
+        def loss_fn(trainable):
+            feats, stats_q = apply_encoder(trainable["enc"], state.batch_stats_q, x_cat)
+            preds, stats_pred = apply_predictor(
+                trainable["pred"], state.batch_stats_pred, feats
+            )
+            q1, q2 = jnp.split(l2_normalize(preds), 2, axis=0)
+            loss1, logits = ctr(q1, k2_g)
+            loss2, _ = ctr(q2, k1_g)
+            return loss1 + loss2, (stats_q, stats_pred, logits)
+
+        trainable = {"enc": state.params_q, "pred": state.params_pred}
+        (loss, (stats_q, stats_pred, logits)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(trainable)
+        if cfg.freeze_patch_embed and "patch_embed" in grads["enc"].get("backbone", {}):
+            grads["enc"]["backbone"]["patch_embed"] = jax.tree.map(
+                jnp.zeros_like, grads["enc"]["backbone"]["patch_embed"]
+            )
+        grads = lax.pmean(grads, DATA_AXIS)
+        metrics = {"loss": loss, **topk_accuracy(logits, labels)}
+        metrics = lax.pmean(metrics, DATA_AXIS)
+        stats_q = lax.pmean(stats_q, DATA_AXIS)
+        stats_k = lax.pmean(stats_k, DATA_AXIS)
+        stats_pred = lax.pmean(stats_pred, DATA_AXIS)
+
+        updates, opt_state = tx.update(grads, state.opt_state, trainable)
+        if cfg.freeze_patch_embed and "patch_embed" in updates["enc"].get("backbone", {}):
+            # zeroed grads are not enough: AdamW's decoupled weight decay
+            # still moves zero-grad params, so zero the *update* as well
+            updates["enc"]["backbone"]["patch_embed"] = jax.tree.map(
+                jnp.zeros_like, updates["enc"]["backbone"]["patch_embed"]
+            )
+        new_trainable = optax.apply_updates(trainable, updates)
+        new_state = state.replace(
+            step=state.step + 1,
+            params_q=new_trainable["enc"],
+            params_pred=new_trainable["pred"],
+            params_k=params_k,
+            batch_stats_q=stats_q,
+            batch_stats_k=stats_k,
+            batch_stats_pred=stats_pred,
+            opt_state=opt_state,
+        )
+        return new_state, metrics
+
     def step_fn(state: MocoState, batch, root_rng):
+        if cfg.v3:
+            return v3_step(state, batch)
         im_q, im_k = batch["im_q"], batch["im_k"]
         local_b = im_q.shape[0]
         # Deterministic per-step randomness, identical on every device:
@@ -195,7 +336,7 @@ def make_train_step(
 
         # (1) EMA momentum update of the key encoder, *before* the key
         # forward, as upstream orders it (moco/builder.py:~L139-141).
-        params_k = ema_update(state.params_k, state.params_q, cfg.momentum)
+        params_k = ema_update(state.params_k, state.params_q, ema_momentum(state.step))
 
         # (2) Shuffle-BN: compute keys on a batch that contains none of
         # this device's own positives.
@@ -223,8 +364,8 @@ def make_train_step(
         k_global = lax.stop_gradient(k_global)
 
         # (3) Query forward + InfoNCE loss (moco/builder.py:~L128-161).
-        def loss_fn(params_q):
-            q, stats_q = apply_encoder(params_q, state.batch_stats_q, im_q)
+        def loss_fn(trainable):
+            q, stats_q = apply_encoder(trainable["enc"], state.batch_stats_q, im_q)
             q = l2_normalize(q)
             if cfg.num_negatives:
                 logits, labels = infonce_logits(q, k_local, state.queue, cfg.temperature)
@@ -242,8 +383,9 @@ def make_train_step(
             loss = cross_entropy(logits, labels)
             return loss, (stats_q, logits, labels)
 
+        trainable = {"enc": state.params_q, "pred": state.params_pred}
         (loss, (stats_q, logits, labels)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params_q
+            trainable
         )
 
         # (4) Gradient + metric reduction over data (DDP all-reduce equiv).
@@ -262,8 +404,8 @@ def make_train_step(
         stats_k = lax.pmean(stats_k, DATA_AXIS)
 
         # (5) Optimizer update (replicated, identical on all devices).
-        updates, opt_state = tx.update(grads, state.opt_state, state.params_q)
-        params_q = optax.apply_updates(state.params_q, updates)
+        updates, opt_state = tx.update(grads, state.opt_state, trainable)
+        params_q = optax.apply_updates(trainable, updates)["enc"]
 
         # (6) FIFO enqueue of the global key batch
         # (moco/builder.py:~L62-77); with a model-sharded queue each shard
@@ -284,7 +426,7 @@ def make_train_step(
         else:
             queue, queue_ptr = state.queue, state.queue_ptr
 
-        new_state = MocoState(
+        new_state = state.replace(
             step=state.step + 1,
             params_q=params_q,
             params_k=params_k,

@@ -1,5 +1,6 @@
 from moco_tpu.models.resnet import ARCHS, BasicBlock, Bottleneck, ResNet, create_resnet
-from moco_tpu.models.heads import LinearClassifier, ProjectionHead
+from moco_tpu.models.heads import LinearClassifier, ProjectionHead, V3MLPHead
+from moco_tpu.models.vit import VIT_ARCHS, VisionTransformer, create_vit, sincos_2d_posembed
 
 __all__ = [
     "ARCHS",
@@ -9,4 +10,9 @@ __all__ = [
     "create_resnet",
     "LinearClassifier",
     "ProjectionHead",
+    "V3MLPHead",
+    "VIT_ARCHS",
+    "VisionTransformer",
+    "create_vit",
+    "sincos_2d_posembed",
 ]

@@ -26,13 +26,49 @@ class ProjectionHead(nn.Module):
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, train: bool = True):  # train unused; BN-free head
         x = x.astype(self.dtype)
         if self.mlp:
             hidden = self.hidden_dim or x.shape[-1]
             x = nn.Dense(hidden, dtype=self.dtype)(x)
             x = nn.relu(x)
         x = nn.Dense(self.dim, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+class V3MLPHead(nn.Module):
+    """MoCo v3 projection/prediction MLP (arXiv:2104.02057 §4 / the
+    follow-up `facebookresearch/moco-v3` repo's `build_mlp`): Dense→BN→ReLU
+    per hidden layer, final Dense with bias-free output BN (no affine).
+    `cross_replica_axis` makes the BN a SyncBN over the mesh's data axis
+    (the paper trains with SyncBN in the heads).
+
+    3 layers / hidden 4096 / out 256 = projection; 2 layers = prediction.
+    """
+
+    num_layers: int = 3
+    hidden_dim: int = 4096
+    dim: int = 256
+    cross_replica_axis: str | None = None
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        norm = lambda **kw: nn.BatchNorm(
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+            axis_name=self.cross_replica_axis,
+            **kw,
+        )
+        for _ in range(self.num_layers - 1):
+            x = nn.Dense(self.hidden_dim, use_bias=False, dtype=self.dtype)(x)
+            x = norm()(x)
+            x = nn.relu(x)
+        x = nn.Dense(self.dim, use_bias=False, dtype=self.dtype)(x)
+        x = norm(use_bias=False, use_scale=False)(x)
         return x.astype(jnp.float32)
 
 

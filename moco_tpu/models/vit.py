@@ -1,0 +1,144 @@
+"""Vision Transformer backbone for MoCo v3.
+
+The reference repo itself is CNN-only (SURVEY.md §5.7); MoCo v3
+("An Empirical Study of Training Self-Supervised Vision Transformers",
+arXiv:2104.02057, from the same authors' follow-up `facebookresearch/
+moco-v3`) is the queue-free ViT variant named by BASELINE.json's config
+list. TPU-first choices:
+- fixed 2-D sin-cos position embedding (the v3 paper's choice — no
+  learned posembed to shard or interpolate);
+- optionally frozen random patch projection (v3's key stability trick:
+  the patch-embed conv stays at init; handled by the train step masking
+  its grads, `freeze_patch_embed` in the config);
+- pre-LN blocks, GELU MLP, bf16 compute / fp32 params, static 197-token
+  sequence — everything XLA wants: one fused attention matmul chain on
+  the MXU, no dynamic shapes.
+
+Attention uses plain `jnp.einsum` — at 197 tokens the whole sequence
+fits in VMEM and XLA's fusion is already optimal; a pallas flash kernel
+(moco_tpu/ops) only pays off at the long sequences ring attention serves.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def sincos_2d_posembed(dim: int, grid: int, cls_token: bool = True) -> np.ndarray:
+    """Fixed 2-D sin-cos position embedding, (1, grid²[+1], dim) fp32."""
+    assert dim % 4 == 0, "sincos 2d posembed needs dim % 4 == 0"
+    coords = np.arange(grid, dtype=np.float32)
+    omega = 1.0 / (10000 ** (np.arange(dim // 4, dtype=np.float32) / (dim // 4)))
+    out_h = np.einsum("i,j->ij", coords, omega)  # (grid, dim/4)
+    emb_h = np.concatenate([np.sin(out_h), np.cos(out_h)], axis=1)  # (grid, dim/2)
+    emb = np.concatenate(
+        [
+            np.repeat(emb_h[:, None, :], grid, axis=1),  # y
+            np.repeat(emb_h[None, :, :], grid, axis=0),  # x
+        ],
+        axis=-1,
+    ).reshape(grid * grid, dim)
+    if cls_token:
+        emb = np.concatenate([np.zeros((1, dim), np.float32), emb], axis=0)
+    return emb[None]
+
+
+class MlpBlock(nn.Module):
+    mlp_dim: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        d = x.shape[-1]
+        x = nn.Dense(self.mlp_dim, dtype=self.dtype)(x)
+        x = nn.gelu(x)
+        return nn.Dense(d, dtype=self.dtype)(x)
+
+
+class EncoderBlock(nn.Module):
+    num_heads: int
+    mlp_dim: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        y = nn.MultiHeadDotProductAttention(
+            num_heads=self.num_heads, dtype=self.dtype, deterministic=True
+        )(y, y)
+        x = x + y
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        y = MlpBlock(mlp_dim=self.mlp_dim, dtype=self.dtype)(y)
+        return x + y
+
+
+class VisionTransformer(nn.Module):
+    """ViT returning the final-LN cls-token feature (pre-head), the
+    interface shape `ResNet.__call__` has, so `MoCoEncoder` composes
+    either backbone unchanged."""
+
+    patch_size: int = 16
+    hidden_dim: int = 768
+    depth: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    image_size: int = 224
+    dtype: jnp.dtype = jnp.float32
+
+    @property
+    def num_features(self) -> int:
+        return self.hidden_dim
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        b, h, w, _ = x.shape
+        assert h % self.patch_size == 0 and w % self.patch_size == 0, (
+            f"image {h}x{w} not divisible by patch {self.patch_size}"
+        )
+        grid = h // self.patch_size
+        x = x.astype(self.dtype)
+        # Patch embedding: conv stride=patch (the "random patch projection"
+        # v3 freezes — freezing is the train step's job, not the module's).
+        x = nn.Conv(
+            self.hidden_dim,
+            (self.patch_size, self.patch_size),
+            strides=self.patch_size,
+            padding="VALID",
+            name="patch_embed",
+            dtype=self.dtype,
+        )(x)
+        x = x.reshape(b, grid * grid, self.hidden_dim)
+        cls = self.param(
+            "cls_token", nn.initializers.normal(stddev=0.02), (1, 1, self.hidden_dim)
+        )
+        x = jnp.concatenate([jnp.broadcast_to(cls.astype(self.dtype), (b, 1, self.hidden_dim)), x], axis=1)
+        pos = sincos_2d_posembed(self.hidden_dim, grid)
+        x = x + jnp.asarray(pos, self.dtype)
+        for i in range(self.depth):
+            x = EncoderBlock(
+                num_heads=self.num_heads, mlp_dim=self.mlp_dim, dtype=self.dtype, name=f"block_{i}"
+            )(x)
+        x = nn.LayerNorm(dtype=self.dtype, name="final_norm")(x)
+        return x[:, 0].astype(jnp.float32)  # cls token
+
+
+_VIT_CONFIGS = {
+    "vit_tiny": dict(hidden_dim=192, depth=4, num_heads=3, mlp_dim=768),  # tests
+    "vit_s16": dict(hidden_dim=384, depth=12, num_heads=6, mlp_dim=1536),
+    "vit_b16": dict(hidden_dim=768, depth=12, num_heads=12, mlp_dim=3072),
+    "vit_l16": dict(hidden_dim=1024, depth=24, num_heads=16, mlp_dim=4096),
+}
+
+
+def create_vit(arch: str, image_size: int = 224, **kwargs) -> VisionTransformer:
+    if arch not in _VIT_CONFIGS:
+        raise ValueError(f"unknown ViT arch {arch!r}; choose from {sorted(_VIT_CONFIGS)}")
+    return VisionTransformer(image_size=image_size, **_VIT_CONFIGS[arch], **kwargs)
+
+
+VIT_ARCHS = tuple(sorted(_VIT_CONFIGS))

@@ -20,6 +20,9 @@ class MocoConfig:
     dim: int = 128  # --moco-dim
     num_negatives: int = 65536  # --moco-k
     momentum: float = 0.999  # --moco-m
+    # Cosine-anneal the EMA momentum from `momentum` to 1.0 over training
+    # (moco-v3's --moco-m-cos; the EMA-scaling literature's recipe).
+    momentum_cos: bool = False
     temperature: float = 0.07  # --moco-t (0.2 for v2 recipe)
     mlp: bool = False  # --mlp (v2)
     # BN decorrelation strategy: 'gather_perm' (reference-exact Shuffle-BN),
@@ -32,6 +35,12 @@ class MocoConfig:
     # MoCo v3 (queue-free symmetric contrastive): set num_negatives=0,
     # v3=True adds the prediction head.
     v3: bool = False
+    # v3 stability trick (arXiv:2104.02057 §5): keep the ViT patch-embed
+    # projection frozen at its random init.
+    freeze_patch_embed: bool = True
+    # Override the ViT patch size (None = the arch's default, 16);
+    # small-image tests/smoke configs use 4.
+    vit_patch_size: Optional[int] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -66,6 +75,20 @@ class ParallelConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ProbeConfig:
+    """Linear-probe hyperparameters (`main_lincls.py:~L30-95, ~L200-210`):
+    SGD(lr=30.0, momentum=0.9, wd=0), step schedule [60, 80], 100 epochs,
+    frozen backbone with BN in eval mode."""
+
+    lr: float = 30.0
+    momentum: float = 0.9
+    weight_decay: float = 0.0
+    schedule: Tuple[int, ...] = (60, 80)
+    epochs: int = 100
+    num_classes: int = 1000
+
+
+@dataclasses.dataclass(frozen=True)
 class TrainConfig:
     moco: MocoConfig = dataclasses.field(default_factory=MocoConfig)
     optim: OptimConfig = dataclasses.field(default_factory=OptimConfig)
@@ -76,6 +99,38 @@ class TrainConfig:
     log_every: int = 10  # --print-freq
     checkpoint_every_epochs: int = 1
     steps_per_epoch: Optional[int] = None  # None = derive from dataset size
+
+
+def config_to_dict(cfg: TrainConfig) -> dict:
+    """JSON-serializable dict (tuples become lists) — stored in every
+    checkpoint so downstream tools (linear probe, converters) can rebuild
+    the exact model/optimizer without the user re-specifying flags."""
+    return dataclasses.asdict(cfg)
+
+
+def config_from_dict(d: dict) -> TrainConfig:
+    def build(cls, sub):
+        kwargs = {}
+        for f in dataclasses.fields(cls):
+            if f.name not in sub:
+                continue
+            v = sub[f.name]
+            if isinstance(v, list):
+                v = tuple(v)
+            kwargs[f.name] = v
+        return cls(**kwargs)
+
+    return TrainConfig(
+        moco=build(MocoConfig, d.get("moco", {})),
+        optim=build(OptimConfig, d.get("optim", {})),
+        data=build(DataConfig, d.get("data", {})),
+        parallel=build(ParallelConfig, d.get("parallel", {})),
+        **{
+            k: d[k]
+            for k in ("seed", "workdir", "log_every", "checkpoint_every_epochs", "steps_per_epoch")
+            if k in d
+        },
+    )
 
 
 def _v2(moco: MocoConfig, **kw) -> MocoConfig:
@@ -109,7 +164,18 @@ PRESETS = {
         ),
         data=DataConfig(dataset="imagefolder", aug_plus=True, global_batch=4096),
     ),
+    # BASELINE.json configs[4]: MoCo v3 ViT-B/16, queue-free symmetric
+    # loss, AdamW + warmup (arXiv:2104.02057 recipe: lr=1.5e-4·batch/256,
+    # wd=0.1, 40-epoch warmup, batch 4096).
+    "vit_b16_v3": TrainConfig(
+        moco=MocoConfig(
+            arch="vit_b16", dim=256, num_negatives=0, momentum=0.99,
+            momentum_cos=True, temperature=0.2, v3=True, shuffle="none",
+        ),
+        optim=OptimConfig(
+            optimizer="adamw", lr=2.4e-3, weight_decay=0.1, epochs=300,
+            cos=True, warmup_epochs=40,
+        ),
+        data=DataConfig(dataset="imagefolder", aug_plus=True, global_batch=4096),
+    ),
 }
-# BASELINE.json configs[4] (MoCo v3 ViT-B/16 queue-free) is added to
-# PRESETS by moco_tpu.models.vit when the v3 path lands — a preset must
-# never name an arch the factory can't build.

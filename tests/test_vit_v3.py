@@ -1,0 +1,162 @@
+"""MoCo v3 ViT: backbone shape/determinism, symmetric train step,
+patch-embed freeze, multi-device run.
+
+The v3 variant is queue-free (batch negatives, symmetric 2τ-scaled loss,
+prediction head) per arXiv:2104.02057; the reference repo itself is
+CNN-only (SURVEY.md §5.7)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from moco_tpu.core import (
+    build_encoder,
+    build_predictor,
+    create_state,
+    make_train_step,
+    place_state,
+)
+from moco_tpu.models import create_vit, sincos_2d_posembed
+from moco_tpu.parallel import create_mesh, shard_batch
+from moco_tpu.utils.config import DataConfig, MocoConfig, OptimConfig, TrainConfig
+from moco_tpu.utils.schedules import build_optimizer
+
+IMG = 16  # 4x4 grid of 4px patches
+
+
+def _v3_config(n_data: int) -> TrainConfig:
+    return TrainConfig(
+        moco=MocoConfig(
+            arch="vit_tiny",
+            dim=32,
+            num_negatives=0,
+            momentum=0.99,
+            temperature=0.2,
+            v3=True,
+            shuffle="none",
+            compute_dtype="float32",
+            vit_patch_size=4,
+        ),
+        optim=OptimConfig(optimizer="adamw", lr=1e-3, weight_decay=0.1, epochs=2, cos=True),
+        data=DataConfig(dataset="synthetic", image_size=IMG, global_batch=4 * n_data),
+    )
+
+
+def test_vit_forward_shape_and_determinism():
+    vit = create_vit("vit_tiny", image_size=IMG, patch_size=4)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, IMG, IMG, 3))
+    params = vit.init(jax.random.PRNGKey(1), x)
+    out1 = vit.apply(params, x)
+    out2 = vit.apply(params, x)
+    assert out1.shape == (2, vit.num_features)
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+
+
+def test_sincos_posembed_properties():
+    emb = sincos_2d_posembed(64, 4)
+    assert emb.shape == (1, 17, 64)
+    np.testing.assert_array_equal(emb[0, 0], np.zeros(64))  # cls slot
+    # distinct positions get distinct embeddings
+    assert not np.allclose(emb[0, 1], emb[0, 2])
+
+
+@pytest.fixture(scope="module")
+def v3_setup():
+    n_data = 2
+    config = _v3_config(n_data)
+    mesh = create_mesh(num_data=n_data, num_model=1, devices=jax.devices()[:n_data])
+    encoder = build_encoder(config.moco, num_data=n_data)
+    predictor = build_predictor(config.moco, num_data=n_data)
+    assert predictor is not None
+    tx = build_optimizer(config.optim, steps_per_epoch=4)
+    sample = jnp.zeros((1, IMG, IMG, 3), jnp.float32)
+    state = create_state(jax.random.PRNGKey(0), config, encoder, tx, sample, predictor=predictor)
+    state = place_state(state, mesh)
+    step = make_train_step(config, encoder, tx, mesh, predictor=predictor)
+    batch = {
+        "im_q": jax.random.normal(jax.random.PRNGKey(1), (8, IMG, IMG, 3)),
+        "im_k": jax.random.normal(jax.random.PRNGKey(2), (8, IMG, IMG, 3)),
+    }
+    batch = shard_batch(mesh, batch)
+    rng = jax.device_put(
+        jax.random.PRNGKey(3), jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+    )
+    return config, state, step, batch, rng
+
+
+def test_v3_step_runs_and_is_finite(v3_setup):
+    config, state, step, batch, rng = v3_setup
+    new_state, metrics = step(state, batch, rng)
+    assert int(new_state.step) == 1
+    assert np.isfinite(float(metrics["loss"]))
+    assert 0 <= float(metrics["acc1"]) <= 100
+
+
+def test_v3_patch_embed_frozen(v3_setup):
+    config, state, step, batch, rng = v3_setup
+    new_state, _ = step(state, batch, rng)
+    before = jax.tree.leaves(state.params_q["backbone"]["patch_embed"])
+    after = jax.tree.leaves(new_state.params_q["backbone"]["patch_embed"])
+    for a, b in zip(before, after):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # but the transformer blocks DID train
+    changed = any(
+        not np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(
+            jax.tree.leaves(state.params_q["backbone"]["block_0"]),
+            jax.tree.leaves(new_state.params_q["backbone"]["block_0"]),
+        )
+    )
+    assert changed
+
+
+def test_v3_key_encoder_is_ema(v3_setup):
+    config, state, step, batch, rng = v3_setup
+    new_state, _ = step(state, batch, rng)
+    m = config.moco.momentum
+    q0 = jax.tree.leaves(state.params_q)[0]
+    k0 = jax.tree.leaves(state.params_k)[0]
+    k1 = jax.tree.leaves(new_state.params_k)[0]
+    np.testing.assert_allclose(
+        np.asarray(k1), np.asarray(k0) * m + np.asarray(q0) * (1 - m), rtol=1e-5
+    )
+
+
+def test_momentum_cos_requires_total_steps():
+    import dataclasses as dc
+
+    config = _v3_config(1)
+    config = dc.replace(config, moco=dc.replace(config.moco, momentum_cos=True))
+    mesh = create_mesh(num_data=1, num_model=1, devices=jax.devices()[:1])
+    encoder = build_encoder(config.moco, num_data=1)
+    predictor = build_predictor(config.moco, num_data=1)
+    tx = build_optimizer(config.optim, steps_per_epoch=4)
+    with pytest.raises(ValueError, match="total_steps"):
+        make_train_step(config, encoder, tx, mesh, predictor=predictor)
+    # with total_steps it builds fine
+    make_train_step(config, encoder, tx, mesh, predictor=predictor, total_steps=8)
+
+
+def test_v3_resnet_gets_v3_heads():
+    """v3 + ResNet must use the 3-layer BN-MLP projection head, not the
+    v2 head (hybrid-model regression guard)."""
+    cfg = MocoConfig(
+        arch="resnet18", dim=32, num_negatives=0, v3=True,
+        shuffle="none", cifar_stem=True, compute_dtype="float32",
+    )
+    enc = build_encoder(cfg, num_data=1)
+    from moco_tpu.models import V3MLPHead
+
+    assert isinstance(enc.head, V3MLPHead)
+    assert enc.head.num_layers == 3
+
+
+def test_v3_predictor_trains(v3_setup):
+    config, state, step, batch, rng = v3_setup
+    new_state, _ = step(state, batch, rng)
+    changed = any(
+        not np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(state.params_pred), jax.tree.leaves(new_state.params_pred))
+    )
+    assert changed
