@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""CLI for the linear probe — the TPU-native `main_lincls.py`.
+
+Usage:
+    python eval_lincls.py --pretrained /tmp/moco \
+        --data imagefolder --data-dir /data/imagenet --batch-size 256
+
+`--pretrained` points at the pretraining workdir (an Orbax checkpoint
+directory written by train.py). The model architecture and optimizer
+template come from the config stored inside the checkpoint — no need to
+re-specify `--arch`/`--mlp` (the reference makes the user repeat them and
+asserts the keys match, `main_lincls.py:~L170-195`)."""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+
+from moco_tpu.utils.config import DataConfig, ProbeConfig
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="MoCo TPU linear probe")
+    p.add_argument("--pretrained", required=True, help="pretraining workdir (Orbax)")
+    p.add_argument("--lr", type=float, default=30.0)
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--wd", type=float, default=0.0)
+    p.add_argument("--schedule", type=int, nargs="*", default=[60, 80])
+    p.add_argument("--epochs", type=int, default=100)
+    p.add_argument("--num-classes", type=int, default=1000)
+    p.add_argument("--data", dest="dataset", choices=("synthetic", "cifar10", "imagefolder"), default=None)
+    p.add_argument("--data-dir", default=None)
+    p.add_argument("--image-size", type=int, default=None)
+    p.add_argument("--batch-size", "-b", type=int, default=None)
+    p.add_argument("--workers", "-j", type=int, default=None)
+    p.add_argument("--workdir", default=None)
+    return p
+
+
+def main() -> None:
+    args = build_parser().parse_args()
+    probe = ProbeConfig(
+        lr=args.lr,
+        momentum=args.momentum,
+        weight_decay=args.wd,
+        schedule=tuple(args.schedule),
+        epochs=args.epochs,
+        num_classes=args.num_classes,
+    )
+    from moco_tpu.lincls import train_lincls
+    from moco_tpu.utils.checkpoint import CheckpointManager
+    from moco_tpu.utils.config import config_from_dict
+
+    # data defaults come from the checkpointed config; flags override
+    mgr = CheckpointManager(args.pretrained)
+    extra = mgr.read_extra()
+    mgr.close()
+    base_data = (
+        config_from_dict(extra["config"]).data if "config" in extra else DataConfig()
+    )
+    overrides = {
+        k: v
+        for k, v in {
+            "dataset": args.dataset,
+            "data_dir": args.data_dir,
+            "image_size": args.image_size,
+            "global_batch": args.batch_size,
+            "num_workers": args.workers,
+        }.items()
+        if v is not None
+    }
+    data = dataclasses.replace(base_data, **overrides)
+
+    result = train_lincls(args.pretrained, probe, data=data, workdir=args.workdir)
+    print(f"best Acc@1: {result['best_acc1']:.3f}")
+
+
+if __name__ == "__main__":
+    main()

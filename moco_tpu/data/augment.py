@@ -266,6 +266,9 @@ class AugRecipe(NamedTuple):
 
 V1_RECIPE = AugRecipe("v1", True, (0.4, 0.4, 0.4, 0.4), 1.0, 0.2, 0.0)
 V2_RECIPE = AugRecipe("v2", True, (0.4, 0.4, 0.4, 0.1), 0.8, 0.2, 0.5)
+# Linear-probe training transform (`main_lincls.py` train pipeline):
+# RandomResizedCrop (default scale 0.08-1.0) + flip + normalize only.
+PROBE_RECIPE = AugRecipe("probe", True, (0.0, 0.0, 0.0, 0.0), 0.0, 0.0, 0.0, (0.08, 1.0))
 
 
 def apply_recipe(
@@ -280,6 +283,8 @@ def apply_recipe(
         # v1 order: crop, grayscale, jitter, flip (main_moco.py:~L245-255)
         x = random_grayscale(k_gray, x, recipe.grayscale_prob)
         x = color_jitter(k_jit, x, *recipe.jitter, apply_prob=recipe.jitter_prob)
+    elif recipe.name == "probe":
+        pass  # crop + flip + normalize only
     else:
         # v2 order: crop, jitter(p=0.8), grayscale, blur, flip (~L228-240)
         x = color_jitter(k_jit, x, *recipe.jitter, apply_prob=recipe.jitter_prob)

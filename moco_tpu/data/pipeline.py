@@ -1,18 +1,21 @@
-"""Input pipeline: host decode → device two-crop augment → prefetch.
+"""Input pipeline: host decode → device augment → prefetch.
 
 Replaces the reference's `DataLoader(workers=32)` + `TwoCropsTransform`
 (`main_moco.py:~L255-260`, `moco/loader.py`). Split of labor:
 
-- host threads: index shuffling (per-epoch, seeded — the
+- host: index shuffling (per-epoch, seeded — the
   `DistributedSampler.set_epoch` equivalent), image decode to a fixed
-  uint8 canvas, batch stacking;
+  uint8 canvas (native C++ pool when built, else PIL threads), batch
+  stacking;
 - device: ALL stochastic augmentation, batched and jitted
-  (`moco_tpu.data.augment.two_crop_augment`), producing {'im_q','im_k'}
-  already sharded over the mesh's data axis;
+  (`moco_tpu.data.augment`), already sharded over the mesh's data axis;
 - a depth-2 prefetch queue overlaps host decode with the train step.
 
-drop_last=True semantics (reference DataLoader) — the queue's
-`K % global_batch == 0` invariant requires full batches.
+Training pipelines use drop_last=True semantics (reference DataLoader) —
+the queue's `K % global_batch == 0` invariant requires full batches. The
+eval pipeline instead pads the tail batch and carries a validity mask so
+the whole val split is scored (the reference evaluates the full split
+too).
 """
 
 from __future__ import annotations
@@ -27,68 +30,17 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from moco_tpu.data.augment import AugRecipe, get_recipe, two_crop_augment
+from moco_tpu.data.augment import (
+    AugRecipe,
+    PROBE_RECIPE,
+    apply_recipe,
+    get_recipe,
+    normalize,
+    two_crop_augment,
+)
 from moco_tpu.data.datasets import build_dataset
 from moco_tpu.parallel.mesh import DATA_AXIS
 from moco_tpu.utils.config import DataConfig
-
-
-class TwoCropPipeline:
-    """Iterable over {'im_q','im_k'} device batches for one epoch at a time."""
-
-    def __init__(
-        self,
-        config: DataConfig,
-        mesh: Mesh,
-        seed: int = 0,
-        dataset=None,
-        train: bool = True,
-    ):
-        self.config = config
-        self.mesh = mesh
-        self.seed = seed
-        self.dataset = dataset or build_dataset(
-            config.dataset, config.data_dir, config.image_size, train=train
-        )
-        self.batch_size = config.global_batch
-        if len(self.dataset) < self.batch_size:
-            raise ValueError(
-                f"dataset of {len(self.dataset)} examples < global batch {self.batch_size}"
-            )
-        self.steps_per_epoch = len(self.dataset) // self.batch_size  # drop_last
-        self.recipe: AugRecipe = get_recipe(config.aug_plus, config.image_size)
-        self._pool = ThreadPoolExecutor(max_workers=max(config.num_workers, 1))
-        self._batch_sharding = NamedSharding(mesh, P(DATA_AXIS))
-
-        out_size = config.image_size
-        recipe = self.recipe
-
-        @jax.jit
-        def _augment(rng, raw_uint8):
-            images = raw_uint8.astype(jnp.float32) / 255.0
-            return two_crop_augment(recipe, rng, images, out_size)
-
-        self._augment = _augment
-
-    def _host_batch(self, indices: np.ndarray) -> np.ndarray:
-        loads = list(self._pool.map(self.dataset.load, indices))
-        return np.stack([img for img, _ in loads])
-
-    def epoch(self, epoch: int) -> Iterator[dict]:
-        """Shuffled epoch, seeded by (seed, epoch) — sampler.set_epoch equiv."""
-        order = np.random.default_rng((self.seed, epoch)).permutation(len(self.dataset))
-        rng = jax.random.PRNGKey(self.seed)
-        rng = jax.random.fold_in(rng, epoch)
-
-        def gen():
-            for step in range(self.steps_per_epoch):
-                idx = order[step * self.batch_size : (step + 1) * self.batch_size]
-                raw = self._host_batch(idx)
-                step_rng = jax.random.fold_in(rng, step)
-                raw = jax.device_put(raw, self._batch_sharding)
-                yield self._augment(step_rng, raw)
-
-        return _prefetch(gen(), depth=2)
 
 
 def _prefetch(it: Iterator, depth: int = 2) -> Iterator:
@@ -115,32 +67,143 @@ def _prefetch(it: Iterator, depth: int = 2) -> Iterator:
         yield item
 
 
-class EvalPipeline:
-    """Deterministic center-crop batches with labels, for the linear probe
-    (`main_lincls.py` val transform: Resize(256), CenterCrop(224))."""
+class _HostPipeline:
+    """Shared host-side machinery: dataset build, batch/steps accounting,
+    decode pool, mesh sharding, seeded per-epoch shuffling."""
 
-    def __init__(self, config: DataConfig, mesh: Mesh, train: bool = False, dataset=None):
+    def __init__(
+        self,
+        config: DataConfig,
+        mesh: Mesh,
+        seed: int = 0,
+        dataset=None,
+        train: bool = True,
+        drop_last: bool = True,
+    ):
         self.config = config
+        self.mesh = mesh
+        self.seed = seed
         self.dataset = dataset or build_dataset(
             config.dataset, config.data_dir, config.image_size, train=train
         )
         self.batch_size = config.global_batch
-        self.steps = len(self.dataset) // self.batch_size
-        self.mesh = mesh
-        self._sharding = NamedSharding(mesh, P(DATA_AXIS))
+        if drop_last and len(self.dataset) < self.batch_size:
+            raise ValueError(
+                f"dataset of {len(self.dataset)} examples < global batch {self.batch_size}"
+            )
+        n = len(self.dataset)
+        self.steps_per_epoch = n // self.batch_size if drop_last else -(-n // self.batch_size)
         self._pool = ThreadPoolExecutor(max_workers=max(config.num_workers, 1))
+        self._sharding = NamedSharding(mesh, P(DATA_AXIS))
+
+    def _host_batch(self, indices: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """(images uint8 stack, labels int32) via the native C++ batch path
+        when the dataset provides it, else the Python thread pool."""
+        if hasattr(self.dataset, "load_batch"):  # native/loader.cc decode pool
+            imgs, labels = self.dataset.load_batch(indices)
+            return imgs, np.asarray(labels, np.int32)
+        loads = list(self._pool.map(self.dataset.load, indices))
+        return (
+            np.stack([img for img, _ in loads]),
+            np.asarray([l for _, l in loads], np.int32),
+        )
+
+    def _epoch_order(self, epoch: int) -> np.ndarray:
+        """Seeded shuffle per (seed, epoch) — sampler.set_epoch equivalent."""
+        return np.random.default_rng((self.seed, epoch)).permutation(len(self.dataset))
+
+    def _epoch_rng(self, epoch: int) -> jax.Array:
+        return jax.random.fold_in(jax.random.PRNGKey(self.seed), epoch)
+
+
+class TwoCropPipeline(_HostPipeline):
+    """Iterable over {'im_q','im_k'} device batches for one epoch at a time."""
+
+    def __init__(self, config: DataConfig, mesh: Mesh, seed: int = 0, dataset=None, train: bool = True):
+        super().__init__(config, mesh, seed=seed, dataset=dataset, train=train, drop_last=True)
+        self.recipe: AugRecipe = get_recipe(config.aug_plus, config.image_size)
+        recipe, out_size = self.recipe, config.image_size
+
+        @jax.jit
+        def _augment(rng, raw_uint8):
+            images = raw_uint8.astype(jnp.float32) / 255.0
+            return two_crop_augment(recipe, rng, images, out_size)
+
+        self._augment = _augment
+
+    def epoch(self, epoch: int) -> Iterator[dict]:
+        order, rng = self._epoch_order(epoch), self._epoch_rng(epoch)
+
+        def gen():
+            for step in range(self.steps_per_epoch):
+                idx = order[step * self.batch_size : (step + 1) * self.batch_size]
+                raw, _ = self._host_batch(idx)
+                step_rng = jax.random.fold_in(rng, step)
+                raw = jax.device_put(raw, self._sharding)
+                yield self._augment(step_rng, raw)
+
+        return _prefetch(gen(), depth=2)
+
+
+class LabeledPipeline(_HostPipeline):
+    """Shuffled (images, labels) train batches with the probe transform
+    (`main_lincls.py` train pipeline: RandomResizedCrop + flip + normalize)."""
+
+    def __init__(self, config: DataConfig, mesh: Mesh, seed: int = 0, dataset=None):
+        super().__init__(config, mesh, seed=seed, dataset=dataset, train=True, drop_last=True)
+        base = get_recipe(config.aug_plus, config.image_size)
+        recipe = PROBE_RECIPE._replace(mean=base.mean, std=base.std)
+        out_size = config.image_size
+
+        @jax.jit
+        def _augment(rng, raw_uint8):
+            images = raw_uint8.astype(jnp.float32) / 255.0
+            return apply_recipe(recipe, rng, images, out_size)
+
+        self._augment = _augment
+
+    def epoch(self, epoch: int) -> Iterator[tuple]:
+        order, rng = self._epoch_order(epoch), self._epoch_rng(epoch)
+
+        def gen():
+            for step in range(self.steps_per_epoch):
+                idx = order[step * self.batch_size : (step + 1) * self.batch_size]
+                raw, labels = self._host_batch(idx)
+                step_rng = jax.random.fold_in(rng, step)
+                raw = jax.device_put(raw, self._sharding)
+                yield (
+                    self._augment(step_rng, raw),
+                    jax.device_put(jnp.asarray(labels), self._sharding),
+                )
+
+        return _prefetch(gen(), depth=2)
+
+
+class EvalPipeline(_HostPipeline):
+    """Deterministic center-crop (images, labels, valid_mask) batches for
+    the linear probe (`main_lincls.py` val transform: Resize(256),
+    CenterCrop(224)). The tail batch is padded to full size with repeats
+    and masked so the *entire* split is scored — a truncated class-sorted
+    val set would bias top-1 (the last classes would never be evaluated).
+    """
+
+    def __init__(self, config: DataConfig, mesh: Mesh, train: bool = False, dataset=None):
+        super().__init__(config, mesh, dataset=dataset, train=train, drop_last=False)
+        self.steps = self.steps_per_epoch
 
     def __iter__(self):
-        from moco_tpu.data.augment import get_recipe, normalize
-
         recipe = get_recipe(self.config.aug_plus, self.config.image_size)
+        n = len(self.dataset)
 
         def gen():
             for step in range(self.steps):
-                idx = np.arange(step * self.batch_size, (step + 1) * self.batch_size)
-                loads = list(self._pool.map(self.dataset.load, idx))
-                raw = np.stack([img for img, _ in loads])
-                labels = np.asarray([l for _, l in loads], np.int32)
+                start = step * self.batch_size
+                idx = np.arange(start, min(start + self.batch_size, n))
+                valid = len(idx)
+                if valid < self.batch_size:  # pad the tail, mask the pads
+                    idx = np.concatenate([idx, np.full(self.batch_size - valid, idx[-1])])
+                mask = (np.arange(self.batch_size) < valid).astype(np.float32)
+                raw, labels = self._host_batch(idx)
                 x = jnp.asarray(raw, jnp.float32) / 255.0
                 if x.shape[1] != self.config.image_size:
                     y0 = (x.shape[1] - self.config.image_size) // 2
@@ -149,6 +212,7 @@ class EvalPipeline:
                 yield (
                     jax.device_put(x, self._sharding),
                     jax.device_put(jnp.asarray(labels), self._sharding),
+                    jax.device_put(jnp.asarray(mask), self._sharding),
                 )
 
         return _prefetch(gen(), depth=2)

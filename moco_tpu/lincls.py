@@ -1,0 +1,278 @@
+"""Linear probe ("lincls") — the TPU-native `main_lincls.py`.
+
+Reference semantics reproduced exactly (SURVEY.md §3.2, §2.2 row 10):
+- checkpoint surgery: keep only the pretrained query encoder's *backbone*
+  (`main_lincls.py:~L170-195` keeps `module.encoder_q.*`, drops the
+  projection head / fc). Here backbone and head are separate modules, so
+  surgery is a key lookup, not string munging — and the
+  `assert missing_keys == {fc.weight, fc.bias}` check becomes structural.
+- fresh classifier: weight ~ N(0, 0.01), bias = 0 (`~L160-165`).
+- ONLY the classifier trains: SGD(lr=30.0, momentum=0.9, wd=0), step
+  schedule [60, 80] over 100 epochs (`~L200-210`).
+- the backbone runs in EVAL mode during probe training — frozen BN
+  running statistics, the quirk called out in SURVEY.md §7 hard-part 4
+  (`train()` calls `model.eval()`, `~L300`).
+- `sanity_check()`: after training, every backbone weight is bit-identical
+  to the pretrained checkpoint (`~L380-400`).
+- `model_best` snapshot by validation top-1 (`~L250-260`).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import struct
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from moco_tpu.core.moco import MocoState, build_encoder, create_state
+from moco_tpu.data.pipeline import EvalPipeline, LabeledPipeline
+from moco_tpu.models import LinearClassifier
+from moco_tpu.ops.losses import cross_entropy, topk_accuracy
+from moco_tpu.parallel import create_mesh
+from moco_tpu.parallel.mesh import DATA_AXIS
+from moco_tpu.utils.checkpoint import CheckpointManager, save_best
+from moco_tpu.utils.config import DataConfig, OptimConfig, ProbeConfig, TrainConfig
+from moco_tpu.utils.metrics import AverageMeter, MetricWriter, ProgressMeter
+from moco_tpu.utils.schedules import build_optimizer
+
+
+class ProbeState(struct.PyTreeNode):
+    step: jax.Array
+    fc_params: Any  # the only trainable leaves
+    backbone_params: Any  # frozen
+    backbone_stats: Any  # frozen BN running statistics
+    opt_state: Any
+
+
+def load_pretrained_backbone(
+    workdir: str, config: Optional[TrainConfig] = None
+) -> tuple[Any, Any, TrainConfig]:
+    """Checkpoint surgery: restore the pretraining state and keep
+    `params_q.backbone` + `batch_stats_q.backbone` — the functional
+    equivalent of keeping `module.encoder_q.*` minus the head.
+
+    With `config=None` the training config stored in the checkpoint's
+    extras is used, so the exact model/optimizer template (arch, v3
+    predictor, sgd/lars/adamw opt_state tree) is rebuilt without the
+    caller re-specifying flags. Returns (backbone_params, backbone_stats,
+    config)."""
+    from moco_tpu.core.moco import build_predictor
+    from moco_tpu.utils.config import config_from_dict
+    from moco_tpu.utils.schedules import build_optimizer
+
+    mgr = CheckpointManager(workdir)
+    if config is None:
+        extra = mgr.read_extra()
+        if "config" not in extra:
+            raise KeyError(
+                f"checkpoint under {workdir} carries no config — pass one explicitly"
+            )
+        config = config_from_dict(extra["config"])
+    encoder = build_encoder(config.moco)
+    predictor = build_predictor(config.moco)
+    # the template's opt_state tree must match the saved one exactly, so
+    # build the same optimizer family the pretrain driver used
+    tx = build_optimizer(config.optim, steps_per_epoch=1)
+    sample = jnp.zeros((1, config.data.image_size, config.data.image_size, 3), jnp.float32)
+    template = create_state(
+        jax.random.PRNGKey(0), config, encoder, tx, sample, predictor=predictor
+    )
+    state, _ = mgr.restore(template)
+    mgr.close()
+    missing = {k for k in ("backbone", "head") if k not in state.params_q}
+    if missing:
+        raise KeyError(f"pretrained params_q missing {missing}")
+    return state.params_q["backbone"], state.batch_stats_q.get("backbone", {}), config
+
+
+def _build_probe_model(config: TrainConfig, num_classes: int):
+    from moco_tpu.core.moco import create_backbone
+
+    backbone = create_backbone(config.moco)  # resnet or vit, per the config
+    classifier = LinearClassifier(num_classes=num_classes)
+    return backbone, classifier
+
+
+def make_probe_step(backbone, classifier, tx, mesh):
+    """Jitted probe train step: frozen-backbone eval-mode forward,
+    classifier-only grads, psum over the data axis."""
+
+    def step_fn(state: ProbeState, images, labels):
+        def loss_fn(fc_params):
+            feats = backbone.apply(
+                {"params": state.backbone_params, "batch_stats": state.backbone_stats},
+                images,
+                train=False,  # eval-mode BN — the reference's model.eval() quirk
+            )
+            feats = lax.stop_gradient(feats)
+            logits = classifier.apply({"params": fc_params}, feats)
+            return cross_entropy(logits, labels), logits
+
+        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.fc_params)
+        grads = lax.pmean(grads, DATA_AXIS)
+        metrics = {"loss": loss, **topk_accuracy(logits, labels)}
+        metrics = lax.pmean(metrics, DATA_AXIS)
+        updates, opt_state = tx.update(grads, state.opt_state, state.fc_params)
+        fc_params = optax.apply_updates(state.fc_params, updates)
+        return state.replace(step=state.step + 1, fc_params=fc_params, opt_state=opt_state), metrics
+
+    specs = ProbeState(step=P(), fc_params=P(), backbone_params=P(), backbone_stats=P(), opt_state=P())
+    sharded = jax.shard_map(
+        step_fn,
+        mesh=mesh,
+        in_specs=(specs, P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(specs, P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+def make_eval_step(backbone, classifier, mesh):
+    """Jitted eval step returning masked *sums* (not means), so padded
+    tail batches score exactly the valid examples (`main_lincls.py`
+    evaluates the full split)."""
+
+    def eval_fn(state: ProbeState, images, labels, mask):
+        feats = backbone.apply(
+            {"params": state.backbone_params, "batch_stats": state.backbone_stats},
+            images,
+            train=False,
+        )
+        logits = classifier.apply({"params": state.fc_params}, feats)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        per_ex_loss = logz - jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+        _, top5 = lax.top_k(logits, 5)
+        correct = top5 == labels[:, None]
+        sums = {
+            "loss": jnp.sum(per_ex_loss * mask),
+            "correct1": jnp.sum(correct[:, 0] * mask),
+            "correct5": jnp.sum(jnp.any(correct, axis=1) * mask),
+            "count": jnp.sum(mask),
+        }
+        return lax.psum(sums, DATA_AXIS)
+
+    specs = ProbeState(step=P(), fc_params=P(), backbone_params=P(), backbone_stats=P(), opt_state=P())
+    sharded = jax.shard_map(
+        eval_fn,
+        mesh=mesh,
+        in_specs=(specs, P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+def sanity_check(state: ProbeState, pretrained_backbone: Any) -> None:
+    """`main_lincls.py:~L380-400`: every backbone weight must be
+    bit-identical to the pretrained checkpoint after probe training."""
+    for (path, a), (_, b) in zip(
+        jax.tree_util.tree_leaves_with_path(state.backbone_params),
+        jax.tree_util.tree_leaves_with_path(pretrained_backbone),
+    ):
+        if not np.array_equal(np.asarray(a), np.asarray(b)):
+            raise AssertionError(f"backbone weight changed during probe training: {path}")
+
+
+def train_lincls(
+    pretrain_workdir: str,
+    probe: ProbeConfig,
+    pretrain_config: Optional[TrainConfig] = None,
+    data: Optional[DataConfig] = None,
+    workdir: Optional[str] = None,
+    train_dataset=None,
+    val_dataset=None,
+    log_every: int = 10,
+) -> dict:
+    """Full linear-probe run; returns {'best_acc1', 'acc1', 'acc5', ...}.
+
+    `pretrain_config=None` reads the config stored in the checkpoint."""
+    workdir = workdir or (pretrain_workdir.rstrip("/") + "_lincls")
+    mesh = create_mesh(num_model=1)
+
+    backbone_params, backbone_stats, pretrain_config = load_pretrained_backbone(
+        pretrain_workdir, pretrain_config
+    )
+    data = data or pretrain_config.data
+    backbone, classifier = _build_probe_model(pretrain_config, probe.num_classes)
+
+    train_pipe = LabeledPipeline(data, mesh, seed=1, dataset=train_dataset)
+    val_pipe = EvalPipeline(data, mesh, train=False, dataset=val_dataset)
+    steps_per_epoch = train_pipe.steps_per_epoch
+
+    optim_cfg = OptimConfig(
+        optimizer="sgd",
+        lr=probe.lr,
+        momentum=probe.momentum,
+        weight_decay=probe.weight_decay,
+        cos=False,
+        schedule=probe.schedule,
+        epochs=probe.epochs,
+    )
+    tx = build_optimizer(optim_cfg, steps_per_epoch)  # honors weight_decay
+
+    sample = jnp.zeros((1, data.image_size, data.image_size, 3), jnp.float32)
+    fc_vars = classifier.init(
+        jax.random.PRNGKey(2), jnp.zeros((1, backbone.num_features), jnp.float32)
+    )
+    state = ProbeState(
+        step=jnp.zeros((), jnp.int32),
+        fc_params=fc_vars["params"],
+        backbone_params=backbone_params,
+        backbone_stats=backbone_stats,
+        opt_state=tx.init(fc_vars["params"]),
+    )
+    rep = NamedSharding(mesh, P())
+    state = jax.tree.map(lambda x: jax.device_put(x, rep), state)
+
+    step_fn = make_probe_step(backbone, classifier, tx, mesh)
+    eval_fn = make_eval_step(backbone, classifier, mesh)
+    writer = MetricWriter(workdir)
+    ckpt = CheckpointManager(workdir, keep=1)
+
+    best_acc1, last_val = 0.0, {}
+    for epoch in range(probe.epochs):
+        losses = AverageMeter("Loss", ":.4e")
+        top1 = AverageMeter("Acc@1", ":6.2f")
+        top5 = AverageMeter("Acc@5", ":6.2f")
+        progress = ProgressMeter(steps_per_epoch, [losses, top1, top5], prefix=f"Epoch: [{epoch}]")
+        for i, (images, labels) in enumerate(train_pipe.epoch(epoch)):
+            state, metrics = step_fn(state, images, labels)
+            if i % log_every == 0 or i == steps_per_epoch - 1:
+                m = {k: float(v) for k, v in metrics.items()}
+                losses.update(m["loss"], data.global_batch)
+                top1.update(m["acc1"], data.global_batch)
+                top5.update(m["acc5"], data.global_batch)
+                progress.display(i)
+                writer.write(int(state.step), {"epoch": epoch, "split": "train", **m})
+
+        last_val = validate(eval_fn, state, val_pipe)
+        writer.write(int(state.step), {"epoch": epoch, "split": "val", **last_val})
+        print(f" * Acc@1 {last_val['acc1']:.3f} Acc@5 {last_val['acc5']:.3f}")
+        ckpt.save(epoch, state, extra={"epoch": epoch, "acc1": last_val["acc1"]})
+        if last_val["acc1"] > best_acc1:
+            best_acc1 = last_val["acc1"]
+            save_best(workdir, state, metric=best_acc1)
+
+    sanity_check(state, backbone_params)
+    writer.close()
+    ckpt.close()
+    return {"best_acc1": best_acc1, **last_val}
+
+
+def validate(eval_fn, state: ProbeState, val_pipe: EvalPipeline) -> dict:
+    """Top-1/top-5 over the FULL val split (`main_lincls.py:~L330-370`)."""
+    loss = c1 = c5 = n = 0.0
+    for images, labels, mask in val_pipe:
+        s = eval_fn(state, images, labels, mask)
+        loss += float(s["loss"])
+        c1 += float(s["correct1"])
+        c5 += float(s["correct5"])
+        n += float(s["count"])
+    n = max(n, 1.0)
+    return {"loss": loss / n, "acc1": 100.0 * c1 / n, "acc5": 100.0 * c5 / n, "count": n}

@@ -1,0 +1,140 @@
+"""Pretraining driver — the TPU-native `main_moco.py`.
+
+Reference call stack (SURVEY.md §3.1): argparse → `mp.spawn` one process
+per GPU → NCCL init → build MoCo → DDP wrap → SGD → per-epoch
+`adjust_learning_rate` + `train()` + rank-0 checkpoint. Here the whole
+process topology collapses into one SPMD program over a
+`jax.sharding.Mesh`: no spawn, no rendezvous, no rank bookkeeping — the
+mesh and the jitted `train_step` are the distribution model, the LR
+schedule lives inside the optimizer, and Orbax handles multi-host
+checkpointing.
+
+Library entry: `train(config) -> final metrics`. CLI: repo-root
+`train.py` (argparse mapping the reference's flags onto `TrainConfig`).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from moco_tpu.core import build_encoder, build_predictor, create_state, make_train_step, place_state
+from moco_tpu.data.pipeline import TwoCropPipeline
+from moco_tpu.parallel import create_mesh
+from moco_tpu.utils.checkpoint import CheckpointManager
+from moco_tpu.utils.config import TrainConfig, config_to_dict
+from moco_tpu.utils.metrics import AverageMeter, MetricWriter, ProgressMeter, profiler_trace
+from moco_tpu.utils.schedules import build_optimizer, make_lr_schedule
+
+
+def train(
+    config: TrainConfig,
+    dataset=None,
+    profile_dir: Optional[str] = None,
+) -> dict:
+    """Run the full pretraining loop; returns the last epoch's mean metrics.
+
+    `dataset` overrides the config-built dataset (tests inject synthetic
+    data of a chosen size this way).
+    """
+    mesh = create_mesh(
+        num_data=config.parallel.num_data, num_model=config.parallel.num_model
+    )
+    num_data = mesh.shape["data"]
+
+    pipeline = TwoCropPipeline(config.data, mesh, seed=config.seed, dataset=dataset)
+    steps_per_epoch = config.steps_per_epoch or pipeline.steps_per_epoch
+    if steps_per_epoch <= 0:
+        raise ValueError("empty pipeline: fewer examples than one global batch")
+
+    encoder = build_encoder(config.moco, num_data=num_data)
+    predictor = build_predictor(config.moco, num_data=num_data)
+    tx = build_optimizer(config.optim, steps_per_epoch=steps_per_epoch)
+    lr_schedule = make_lr_schedule(config.optim, steps_per_epoch)
+
+    rng = jax.random.PRNGKey(config.seed)
+    init_rng, shuffle_rng = jax.random.split(rng)
+    sample = jnp.zeros((1, config.data.image_size, config.data.image_size, 3), jnp.float32)
+    state = create_state(init_rng, config, encoder, tx, sample, predictor=predictor)
+
+    ckpt = CheckpointManager(
+        config.workdir, keep=3, save_interval=config.checkpoint_every_epochs
+    )
+    start_epoch = 0
+    if ckpt.latest_step() is not None:  # --resume semantics, automatic
+        state, extra = ckpt.restore(state)
+        start_epoch = int(extra.get("epoch", 0)) + 1
+        print(f"resumed from epoch {start_epoch - 1} (step {int(state.step)})")
+
+    shard_q = config.parallel.num_model > 1 and config.moco.num_negatives > 0
+    state = place_state(state, mesh, shard_queue_over_model=shard_q)
+    step_fn = make_train_step(
+        config,
+        encoder,
+        tx,
+        mesh,
+        shard_queue_over_model=shard_q,
+        predictor=predictor,
+        total_steps=config.optim.epochs * steps_per_epoch,
+    )
+    root_rng = jax.device_put(
+        shuffle_rng, jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+    )
+
+    writer = MetricWriter(config.workdir)
+    last_avg: dict = {}
+    with profiler_trace(profile_dir):
+        for epoch in range(start_epoch, config.optim.epochs):
+            batch_time = AverageMeter("Time", ":6.3f")
+            data_time = AverageMeter("Data", ":6.3f")
+            losses = AverageMeter("Loss", ":.4e")
+            top1 = AverageMeter("Acc@1", ":6.2f")
+            top5 = AverageMeter("Acc@5", ":6.2f")
+            progress = ProgressMeter(
+                steps_per_epoch,
+                [batch_time, data_time, losses, top1, top5],
+                prefix=f"Epoch: [{epoch}]",
+            )
+            end = time.perf_counter()
+            for i, batch in enumerate(pipeline.epoch(epoch)):
+                if i >= steps_per_epoch:
+                    break
+                data_time.update(time.perf_counter() - end)
+                state, metrics = step_fn(state, batch, root_rng)
+                if i % config.log_every == 0 or i == steps_per_epoch - 1:
+                    # host sync only on log steps — keeps the device queue full
+                    m = {k: float(v) for k, v in metrics.items()}
+                    bs = config.data.global_batch
+                    losses.update(m["loss"], bs)
+                    top1.update(m["acc1"], bs)
+                    top5.update(m["acc5"], bs)
+                    batch_time.update(time.perf_counter() - end)
+                    progress.display(i)
+                    writer.write(
+                        int(state.step),
+                        {
+                            "epoch": epoch,
+                            "lr": float(lr_schedule(int(state.step) - 1)),
+                            **m,
+                        },
+                    )
+                end = time.perf_counter()
+            last_avg = {
+                "epoch": epoch,
+                "loss": losses.avg,
+                "acc1": top1.avg,
+                "acc5": top5.avg,
+            }
+            ckpt.save(
+                epoch,
+                state,
+                extra={"epoch": epoch, "config": config_to_dict(config)},
+                force=epoch == config.optim.epochs - 1,  # never skip the last
+            )
+    writer.close()
+    ckpt.close()
+    return last_avg

