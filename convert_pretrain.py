@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""Convert a pretraining checkpoint for transfer evaluation — the
+TPU-native `detection/convert-pretrain-to-detectron2.py` (plus a torch
+state-dict export for the wider ecosystem).
+
+Usage:
+    python convert_pretrain.py WORKDIR out.pkl   # detectron2 pickle
+    python convert_pretrain.py WORKDIR out.pth   # torch state_dict
+
+The backbone architecture is read from the config stored in the
+checkpoint."""
+
+from __future__ import annotations
+
+import argparse
+
+from moco_tpu.export import (
+    STAGE_SIZES,
+    resnet_to_torchvision,
+    save_detectron2_pickle,
+    save_torch_state_dict,
+)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("workdir", help="pretraining workdir (Orbax checkpoints)")
+    p.add_argument("output", help="output .pkl (detectron2) or .pth (torch)")
+    p.add_argument("--format", choices=("d2", "torch"), default=None,
+                   help="default: inferred from the output extension")
+    args = p.parse_args()
+
+    from moco_tpu.lincls import load_pretrained_backbone
+
+    # arch and template come from the config stored in the checkpoint
+    params, stats, config = load_pretrained_backbone(args.workdir)
+    arch = config.moco.arch
+    if arch not in STAGE_SIZES:
+        raise SystemExit(f"export supports the ResNet family only, got {arch!r}")
+    state = resnet_to_torchvision(params, stats, stage_sizes=STAGE_SIZES[arch])
+
+    fmt = args.format or ("torch" if args.output.endswith(".pth") else "d2")
+    if fmt == "d2":
+        save_detectron2_pickle(state, args.output)
+    else:
+        save_torch_state_dict(state, args.output)
+    print(f"wrote {len(state)} tensors -> {args.output} ({fmt})")
+
+
+if __name__ == "__main__":
+    main()

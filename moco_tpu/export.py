@@ -1,0 +1,145 @@
+"""Checkpoint export for transfer evaluation.
+
+The reference bridges pretraining → Detectron2 with
+`detection/convert-pretrain-to-detectron2.py` (~35 LoC, SURVEY.md §2.2
+row 11): load the `.pth.tar`, keep `module.encoder_q.` backbone keys
+(drop fc/head), rename to Detectron2's ResNet naming, dump a pickle
+`{"model": …, "__author__": "MOCO", "matching_heuristics": True}`.
+
+Here the chain is: Orbax checkpoint → (1) a *torchvision-named* numpy
+state dict — the universal interop format the rest of the GPU ecosystem
+(timm, detectron2, mmdet converters) consumes — → (2) the same Detectron2
+pickle the reference emits. Detection fine-tuning itself stays on
+Detectron2/GPU, exactly as the reference's does (SURVEY.md §2.2's
+native-dependency table scopes ROIAlign/NMS out of the TPU core).
+
+Flax→torch weight-layout rules:
+- conv kernels (H, W, Cin, Cout) → (Cout, Cin, H, W)
+- dense kernels (Cin, Cout) → (Cout, Cin)
+- BatchNorm: scale→weight, bias→bias, mean→running_mean, var→running_var
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Dict
+
+import numpy as np
+
+
+def _np(x) -> np.ndarray:
+    return np.asarray(x, dtype=np.float32)
+
+
+def _conv(kernel) -> np.ndarray:
+    return _np(kernel).transpose(3, 2, 0, 1)
+
+
+def _convbn(out: Dict[str, np.ndarray], params, stats, conv_name: str, bn_name: str) -> None:
+    out[f"{conv_name}.weight"] = _conv(params["Conv_0"]["kernel"])
+    bn_p, bn_s = params["BatchNorm_0"], stats["BatchNorm_0"]
+    out[f"{bn_name}.weight"] = _np(bn_p["scale"])
+    out[f"{bn_name}.bias"] = _np(bn_p["bias"])
+    out[f"{bn_name}.running_mean"] = _np(bn_s["mean"])
+    out[f"{bn_name}.running_var"] = _np(bn_s["var"])
+
+
+def resnet_to_torchvision(
+    backbone_params: Any, backbone_stats: Any, stage_sizes=(3, 4, 6, 3)
+) -> Dict[str, np.ndarray]:
+    """Flax ResNet (moco_tpu.models.resnet) → torchvision ResNet names.
+
+    Works for both BasicBlock (2 ConvBNs + optional downsample) and
+    Bottleneck (3 + optional downsample); block class is inferred from
+    the parameter tree.
+    """
+    out: Dict[str, np.ndarray] = {}
+    p, s = backbone_params, backbone_stats
+    # stem (ImageNet stem: top-level Conv_0 + BatchNorm_0; CIFAR stem:
+    # a ConvBN_0 submodule)
+    if "Conv_0" in p:
+        out["conv1.weight"] = _conv(p["Conv_0"]["kernel"])
+        bn_p, bn_s = p["BatchNorm_0"], s["BatchNorm_0"]
+        out["bn1.weight"] = _np(bn_p["scale"])
+        out["bn1.bias"] = _np(bn_p["bias"])
+        out["bn1.running_mean"] = _np(bn_s["mean"])
+        out["bn1.running_var"] = _np(bn_s["var"])
+    else:  # cifar stem
+        _convbn(out, p["ConvBN_0"], s["ConvBN_0"], "conv1", "bn1")
+
+    block_names = sorted(
+        (k for k in p if k.startswith(("Bottleneck_", "BasicBlock_"))),
+        key=lambda k: int(k.rsplit("_", 1)[1]),
+    )
+    idx = 0
+    for stage, num_blocks in enumerate(stage_sizes):
+        for j in range(num_blocks):
+            name = block_names[idx]
+            bp, bs = p[name], s[name]
+            n_convbn = sum(1 for k in bp if k.startswith("ConvBN_"))
+            is_bottleneck = name.startswith("Bottleneck_")
+            n_main = 3 if is_bottleneck else 2
+            prefix = f"layer{stage + 1}.{j}"
+            for c in range(n_main):
+                _convbn(
+                    out, bp[f"ConvBN_{c}"], bs[f"ConvBN_{c}"],
+                    f"{prefix}.conv{c + 1}", f"{prefix}.bn{c + 1}",
+                )
+            if n_convbn > n_main:  # downsample branch
+                d = bp[f"ConvBN_{n_main}"]
+                ds = bs[f"ConvBN_{n_main}"]
+                out[f"{prefix}.downsample.0.weight"] = _conv(d["Conv_0"]["kernel"])
+                out[f"{prefix}.downsample.1.weight"] = _np(d["BatchNorm_0"]["scale"])
+                out[f"{prefix}.downsample.1.bias"] = _np(d["BatchNorm_0"]["bias"])
+                out[f"{prefix}.downsample.1.running_mean"] = _np(ds["BatchNorm_0"]["mean"])
+                out[f"{prefix}.downsample.1.running_var"] = _np(ds["BatchNorm_0"]["var"])
+            idx += 1
+    return out
+
+
+def torchvision_to_detectron2(state: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """The reference converter's renaming
+    (`detection/convert-pretrain-to-detectron2.py:~L10-30`):
+    stem prefix for non-layer keys, layer{t}→res{t+1}, bn{t}→conv{t}.norm,
+    downsample.0→shortcut, downsample.1→shortcut.norm."""
+    out = {}
+    for k, v in state.items():
+        if "layer" not in k:
+            k = "stem." + k
+        for t in (1, 2, 3, 4):
+            k = k.replace(f"layer{t}", f"res{t + 1}")
+        for t in (1, 2, 3):
+            k = k.replace(f"bn{t}", f"conv{t}.norm")
+        k = k.replace("downsample.0", "shortcut")
+        k = k.replace("downsample.1", "shortcut.norm")
+        out[k] = v
+    return out
+
+
+def save_detectron2_pickle(state: Dict[str, np.ndarray], path: str) -> None:
+    """Exactly the reference's output envelope (`~L30-35`)."""
+    blob = {
+        "model": torchvision_to_detectron2(state),
+        "__author__": "MOCO",
+        "matching_heuristics": True,
+    }
+    with open(path, "wb") as f:
+        pickle.dump(blob, f)
+
+
+def save_torch_state_dict(state: Dict[str, np.ndarray], path: str) -> None:
+    """torch-loadable `.pth` of the torchvision-named backbone (fc absent —
+    the linear probe / fine-tune attaches its own, as `main_lincls.py`
+    does after its strict=False load)."""
+    import torch
+
+    torch.save({k: torch.from_numpy(np.ascontiguousarray(v)) for k, v in state.items()}, path)
+
+
+STAGE_SIZES = {
+    "resnet18": (2, 2, 2, 2),
+    "resnet34": (3, 4, 6, 3),
+    "resnet50": (3, 4, 6, 3),
+    "resnet101": (3, 4, 23, 3),
+    "resnet152": (3, 8, 36, 3),
+}
