@@ -115,7 +115,12 @@ class ImageFolderDataset:
             # aspect ratios.)
             w, h = im.size
             s = size / min(w, h)
-            im = im.resize((max(size, round(w * s)), max(size, round(h * s))))
+            # explicit BILINEAR: the reference's torchvision transforms
+            # default, and what native/loader.cc reproduces (antialiased)
+            im = im.resize(
+                (max(size, round(w * s)), max(size, round(h * s))),
+                resample=Image.BILINEAR,
+            )
             arr = np.asarray(im, np.uint8)
         # Center-crop the long side to a square canvas of fixed shape so
         # batches stack.
@@ -139,5 +144,12 @@ def build_dataset(name: str, data_dir: Optional[str], image_size: int, train: bo
         if os.path.isdir(os.path.join(data_dir, split)):
             root = os.path.join(data_dir, split)
         # decode canvas ~1.146x the crop (256 for 224-crops, the standard ratio)
-        return ImageFolderDataset(root, decode_size=round(image_size * 256 / 224))
+        decode_size = round(image_size * 256 / 224)
+        from moco_tpu.data.native_loader import native_available
+
+        if native_available():  # C++ decode pool (native/loader.cc)
+            from moco_tpu.data.native_loader import NativeImageFolderDataset
+
+            return NativeImageFolderDataset(root, decode_size=decode_size)
+        return ImageFolderDataset(root, decode_size=decode_size)
     raise ValueError(f"unknown dataset {name!r}")

@@ -1,0 +1,380 @@
+// moco_tpu native data loader.
+//
+// TPU-native equivalent of the reference's DataLoader worker processes
+// (`main_moco.py:~L255-260`: 32 fork'd workers doing PIL decode,
+// SURVEY.md §3.4). Python threads around PIL leave decode throughput
+// hostage to the GIL and per-image Python overhead; at the north-star
+// rate (>2x 168 imgs/s/chip, multi-chip) the host input path must
+// sustain thousands of decoded images per second. This library keeps
+// the whole hot path in C++:
+//
+//   paths -> [worker threads: read file -> libjpeg/libpng decode ->
+//             bilinear shortest-side resize -> center-crop to a fixed
+//             S x S x 3 canvas] -> caller-provided contiguous batch
+//
+// The Python side (moco_tpu/data/native_loader.py, ctypes) hands in a
+// batch of sample indices and a numpy uint8 buffer; workers fill it in
+// parallel with zero Python involvement per image.
+//
+// C ABI (ctypes-friendly):
+//   mtl_create(paths, n, canvas, threads) -> handle
+//   mtl_load_batch(handle, indices, bs, out) -> 0 | error count
+//   mtl_destroy(handle)
+//   mtl_version() -> int
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <functional>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <csetjmp>
+#include <jpeglib.h>
+#include <png.h>
+
+namespace {
+
+// ------------------------------------------------------------- decode
+
+struct Image {
+  std::vector<uint8_t> data;  // HWC, RGB
+  int h = 0, w = 0;
+};
+
+struct JpegErrorMgr {
+  jpeg_error_mgr pub;
+  jmp_buf setjmp_buffer;
+};
+
+void jpeg_error_exit(j_common_ptr cinfo) {
+  auto* err = reinterpret_cast<JpegErrorMgr*>(cinfo->err);
+  longjmp(err->setjmp_buffer, 1);
+}
+
+bool decode_jpeg(const uint8_t* buf, size_t len, Image* out) {
+  jpeg_decompress_struct cinfo;
+  JpegErrorMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = jpeg_error_exit;
+  if (setjmp(jerr.setjmp_buffer)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, buf, len);
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  out->w = cinfo.output_width;
+  out->h = cinfo.output_height;
+  out->data.resize(size_t(out->w) * out->h * 3);
+  const size_t stride = size_t(out->w) * 3;
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t* row = out->data.data() + cinfo.output_scanline * stride;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+struct PngReadState {
+  const uint8_t* data;
+  size_t len, off;
+};
+
+void png_read_cb(png_structp png, png_bytep out, png_size_t n) {
+  auto* s = static_cast<PngReadState*>(png_get_io_ptr(png));
+  if (s->off + n > s->len) {
+    png_error(png, "png: read past end");
+    return;
+  }
+  memcpy(out, s->data + s->off, n);
+  s->off += n;
+}
+
+bool decode_png(const uint8_t* buf, size_t len, Image* out) {
+  if (len < 8 || png_sig_cmp(buf, 0, 8)) return false;
+  png_structp png = png_create_read_struct(PNG_LIBPNG_VER_STRING, nullptr, nullptr, nullptr);
+  if (!png) return false;
+  png_infop info = png_create_info_struct(png);
+  if (!info) {
+    png_destroy_read_struct(&png, nullptr, nullptr);
+    return false;
+  }
+  if (setjmp(png_jmpbuf(png))) {
+    png_destroy_read_struct(&png, &info, nullptr);
+    return false;
+  }
+  PngReadState state{buf, len, 0};
+  png_set_read_fn(png, &state, png_read_cb);
+  png_read_info(png, info);
+  // normalize everything to 8-bit RGB
+  png_set_strip_16(png);
+  png_set_palette_to_rgb(png);
+  png_set_expand_gray_1_2_4_to_8(png);
+  if (png_get_valid(png, info, PNG_INFO_tRNS)) png_set_tRNS_to_alpha(png);
+  png_set_strip_alpha(png);
+  png_set_gray_to_rgb(png);
+  png_read_update_info(png, info);
+  out->w = png_get_image_width(png, info);
+  out->h = png_get_image_height(png, info);
+  out->data.resize(size_t(out->w) * out->h * 3);
+  std::vector<png_bytep> rows(out->h);
+  const size_t stride = size_t(out->w) * 3;
+  for (int y = 0; y < out->h; ++y) rows[y] = out->data.data() + y * stride;
+  png_read_image(png, rows.data());
+  png_destroy_read_struct(&png, &info, nullptr);
+  return true;
+}
+
+bool decode_any(const uint8_t* buf, size_t len, Image* out) {
+  if (len >= 3 && buf[0] == 0xFF && buf[1] == 0xD8) return decode_jpeg(buf, len, out);
+  if (len >= 8 && !png_sig_cmp(buf, 0, 8)) return decode_png(buf, len, out);
+  return decode_jpeg(buf, len, out) || decode_png(buf, len, out);
+}
+
+// ------------------------------------------------- resize + crop
+
+// PIL-style antialiased separable triangle (BILINEAR) resample along one
+// axis: for downscale the filter support widens by the scale factor, so
+// every source pixel inside the footprint contributes (PIL Resample.c
+// semantics; a fixed 2-tap bilinear would alias on downscale and diverge
+// from the Python/PIL path by ~15 gray levels).
+struct ResampleWeights {
+  std::vector<double> weights;  // flattened (out_size, max_taps)
+  std::vector<int> bounds;      // (out_size, 2): xmin, count
+  int max_taps = 0;
+};
+
+ResampleWeights triangle_weights(int in_size, int out_size) {
+  ResampleWeights rw;
+  const double scale = double(in_size) / out_size;
+  const double filterscale = std::max(scale, 1.0);
+  const double support = 1.0 * filterscale;  // triangle support = 1
+  rw.max_taps = int(support * 2 + 1);
+  rw.weights.assign(size_t(out_size) * rw.max_taps, 0.0);
+  rw.bounds.assign(size_t(out_size) * 2, 0);
+  for (int i = 0; i < out_size; ++i) {
+    const double center = (i + 0.5) * scale;
+    int xmin = std::max(0, int(center - support + 0.5));
+    int xmax = std::min(in_size, int(center + support + 0.5));
+    double total = 0.0;
+    for (int x = xmin; x < xmax; ++x) {
+      double arg = std::abs((x + 0.5 - center) / filterscale);
+      double w = arg < 1.0 ? 1.0 - arg : 0.0;
+      rw.weights[size_t(i) * rw.max_taps + (x - xmin)] = w;
+      total += w;
+    }
+    if (total > 0)
+      for (int x = xmin; x < xmax; ++x)
+        rw.weights[size_t(i) * rw.max_taps + (x - xmin)] /= total;
+    rw.bounds[i * 2] = xmin;
+    rw.bounds[i * 2 + 1] = xmax - xmin;
+  }
+  return rw;
+}
+
+// Shortest-side antialiased resize to `canvas` then center-crop to
+// (canvas, canvas) — the semantics of ImageFolderDataset.load
+// (moco_tpu/data/datasets.py) with PIL BILINEAR.
+void resize_center_crop(const Image& src, int canvas, uint8_t* out) {
+  const double scale = double(canvas) / std::min(src.w, src.h);
+  const int nw = std::max(canvas, int(src.w * scale + 0.5));
+  const int nh = std::max(canvas, int(src.h * scale + 0.5));
+  ResampleWeights wx = triangle_weights(src.w, nw);
+  ResampleWeights wy = triangle_weights(src.h, nh);
+
+  // horizontal pass: (h, w) -> (h, nw), float intermediate
+  std::vector<float> tmp(size_t(src.h) * nw * 3);
+  const size_t sstride = size_t(src.w) * 3;
+  for (int y = 0; y < src.h; ++y) {
+    const uint8_t* srow = src.data.data() + y * sstride;
+    float* drow = tmp.data() + size_t(y) * nw * 3;
+    for (int x = 0; x < nw; ++x) {
+      const int xmin = wx.bounds[x * 2], cnt = wx.bounds[x * 2 + 1];
+      const double* w = wx.weights.data() + size_t(x) * wx.max_taps;
+      double acc[3] = {0, 0, 0};
+      for (int k = 0; k < cnt; ++k) {
+        const uint8_t* p = srow + size_t(xmin + k) * 3;
+        acc[0] += w[k] * p[0];
+        acc[1] += w[k] * p[1];
+        acc[2] += w[k] * p[2];
+      }
+      drow[x * 3] = float(acc[0]);
+      drow[x * 3 + 1] = float(acc[1]);
+      drow[x * 3 + 2] = float(acc[2]);
+    }
+  }
+
+  // vertical pass fused with the center crop: emit only canvas rows/cols
+  const int x_off = (nw - canvas) / 2, y_off = (nh - canvas) / 2;
+  for (int y = 0; y < canvas; ++y) {
+    const int yy = y + y_off;
+    const int ymin = wy.bounds[yy * 2], cnt = wy.bounds[yy * 2 + 1];
+    const double* w = wy.weights.data() + size_t(yy) * wy.max_taps;
+    uint8_t* drow = out + size_t(y) * canvas * 3;
+    for (int x = 0; x < canvas; ++x) {
+      const int xx = x + x_off;
+      double acc[3] = {0, 0, 0};
+      for (int k = 0; k < cnt; ++k) {
+        const float* p = tmp.data() + (size_t(ymin + k) * nw + xx) * 3;
+        acc[0] += w[k] * p[0];
+        acc[1] += w[k] * p[1];
+        acc[2] += w[k] * p[2];
+      }
+      for (int c = 0; c < 3; ++c) {
+        double v = acc[c] + 0.5;
+        drow[x * 3 + c] = uint8_t(v < 0 ? 0 : (v > 255 ? 255 : v));
+      }
+    }
+  }
+}
+
+// --------------------------------------------------- thread pool
+
+class Loader {
+ public:
+  Loader(std::vector<std::string> paths, int canvas, int threads)
+      : paths_(std::move(paths)), canvas_(canvas), stop_(false) {
+    const int n = std::max(1, threads);
+    for (int i = 0; i < n; ++i) workers_.emplace_back([this] { worker(); });
+  }
+
+  ~Loader() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_.notify_all();
+    for (auto& t : workers_) t.join();
+  }
+
+  struct BatchCtx {
+    const int64_t* indices;
+    int bs;
+    uint8_t* out;
+    std::atomic<int> next{0}, errors{0}, done{0};
+  };
+
+  // Fill out[(bs, canvas, canvas, 3)] with samples `indices`; returns the
+  // number of failed loads (failed slots are zero-filled). The shared_ptr
+  // keeps the batch context alive for any worker still draining it after
+  // this call returns.
+  int load_batch(const int64_t* indices, int bs, uint8_t* out) {
+    // one batch at a time per handle: concurrent callers (e.g. a Python
+    // thread pool mapping single-image loads) would otherwise race on
+    // the batch_ slot
+    std::lock_guard<std::mutex> batch_lk(batch_mu_);
+    auto ctx = std::make_shared<BatchCtx>();
+    ctx->indices = indices;
+    ctx->bs = bs;
+    ctx->out = out;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      batch_ = ctx;
+      batch_gen_++;
+    }
+    cv_.notify_all();
+    run_batch(ctx);  // caller thread participates
+    while (ctx->done.load() < bs) std::this_thread::yield();
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      batch_ = nullptr;
+    }
+    return ctx->errors.load();
+  }
+
+  int canvas() const { return canvas_; }
+  size_t size() const { return paths_.size(); }
+
+ private:
+  bool load_one(int64_t idx, uint8_t* dst) {
+    if (idx < 0 || size_t(idx) >= paths_.size()) return false;
+    FILE* f = fopen(paths_[idx].c_str(), "rb");
+    if (!f) return false;
+    fseek(f, 0, SEEK_END);
+    long len = ftell(f);
+    fseek(f, 0, SEEK_SET);
+    std::vector<uint8_t> buf(len > 0 ? len : 0);
+    if (len <= 0 || fread(buf.data(), 1, len, f) != size_t(len)) {
+      fclose(f);
+      return false;
+    }
+    fclose(f);
+    Image img;
+    if (!decode_any(buf.data(), buf.size(), &img) || img.w < 1 || img.h < 1) return false;
+    resize_center_crop(img, canvas_, dst);
+    return true;
+  }
+
+  void run_batch(const std::shared_ptr<BatchCtx>& ctx) {
+    const size_t frame = size_t(canvas_) * canvas_ * 3;
+    for (;;) {
+      int i = ctx->next.fetch_add(1);
+      if (i >= ctx->bs) break;
+      uint8_t* dst = ctx->out + i * frame;
+      if (!load_one(ctx->indices[i], dst)) {
+        memset(dst, 0, frame);
+        ctx->errors.fetch_add(1);
+      }
+      ctx->done.fetch_add(1);
+    }
+  }
+
+  void worker() {
+    uint64_t seen_gen = 0;
+    for (;;) {
+      std::shared_ptr<BatchCtx> ctx;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [&] { return stop_ || (batch_ && batch_gen_ != seen_gen); });
+        if (stop_) return;
+        seen_gen = batch_gen_;
+        ctx = batch_;
+      }
+      if (ctx) run_batch(ctx);
+    }
+  }
+
+  std::vector<std::string> paths_;
+  int canvas_;
+  std::vector<std::thread> workers_;
+  std::mutex batch_mu_;  // serializes load_batch callers
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::shared_ptr<BatchCtx> batch_;
+  uint64_t batch_gen_ = 0;
+  bool stop_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* mtl_create(const char** paths, int64_t n, int canvas, int threads) {
+  std::vector<std::string> v;
+  v.reserve(n);
+  for (int64_t i = 0; i < n; ++i) v.emplace_back(paths[i]);
+  return new Loader(std::move(v), canvas, threads);
+}
+
+int mtl_load_batch(void* handle, const int64_t* indices, int bs, uint8_t* out) {
+  return static_cast<Loader*>(handle)->load_batch(indices, bs, out);
+}
+
+void mtl_destroy(void* handle) { delete static_cast<Loader*>(handle); }
+
+int mtl_version() { return 1; }
+
+}  // extern "C"
