@@ -219,7 +219,6 @@ def make_train_step(
         raise ValueError("v3 is queue-free: set num_negatives=0")
     if cfg.momentum_cos and total_steps is None:
         raise ValueError("momentum_cos=True needs total_steps for the cosine ramp")
-
     def ema_momentum(step):
         """Constant m, or moco-v3's cosine ramp m -> 1.0 over training."""
         if not cfg.momentum_cos:
@@ -237,6 +236,21 @@ def make_train_step(
         shard_queue_over_model = n_model > 1 and cfg.num_negatives > 0
     if shard_queue_over_model and cfg.num_negatives % (n_model * max(global_batch, 1)):
         raise ValueError("sharded queue requires K % (num_model*global_batch) == 0")
+    # Fused streaming InfoNCE (pallas): auto-on for a TPU backend with a
+    # replicated, tile-divisible queue; explicit True forces it (interpret
+    # mode off-TPU), False forces the dense logits path.
+    use_fused = cfg.fused_infonce
+    if use_fused is None:
+        from moco_tpu.ops.fused_infonce import DEFAULT_BLOCK_K
+
+        use_fused = (
+            jax.default_backend() == "tpu"
+            and not (shard_queue_over_model or n_model > 1)
+            and cfg.num_negatives > 0
+            and cfg.num_negatives % DEFAULT_BLOCK_K == 0
+        )
+    if use_fused and shard_queue_over_model:
+        raise ValueError("fused_infonce does not support a model-sharded queue")
 
     def apply_encoder(params, batch_stats, x, train=True):
         out, mut = encoder.apply(
@@ -367,7 +381,18 @@ def make_train_step(
         def loss_fn(trainable):
             q, stats_q = apply_encoder(trainable["enc"], state.batch_stats_q, im_q)
             q = l2_normalize(q)
-            if cfg.num_negatives:
+            if cfg.num_negatives and use_fused:
+                # streaming pallas kernel: never materializes (B, 1+K)
+                from moco_tpu.ops.fused_infonce import fused_infonce_loss
+
+                loss, acc = fused_infonce_loss(
+                    q,
+                    k_local,
+                    state.queue,
+                    cfg.temperature,
+                    interpret=jax.default_backend() != "tpu",
+                )
+            elif cfg.num_negatives:
                 logits, labels = infonce_logits(q, k_local, state.queue, cfg.temperature)
                 if shard_queue_over_model:
                     # queue rows are sharded over `model`: logits currently
@@ -375,16 +400,19 @@ def make_train_step(
                     l_pos, l_neg = logits[:, :1], logits[:, 1:]
                     l_neg = lax.all_gather(l_neg, MODEL_AXIS, axis=1, tiled=True)
                     logits = jnp.concatenate([l_pos, l_neg], axis=1)
+                loss = cross_entropy(logits, labels)
+                acc = topk_accuracy(logits, labels)
             else:
                 # v3-style queue-free: global batch keys are the negatives.
                 logits = q @ k_global.T / cfg.temperature
                 rank = lax.axis_index(DATA_AXIS)
                 labels = rank * local_b + jnp.arange(local_b, dtype=jnp.int32)
-            loss = cross_entropy(logits, labels)
-            return loss, (stats_q, logits, labels)
+                loss = cross_entropy(logits, labels)
+                acc = topk_accuracy(logits, labels)
+            return loss, (stats_q, acc)
 
         trainable = {"enc": state.params_q, "pred": state.params_pred}
-        (loss, (stats_q, logits, labels)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        (loss, (stats_q, acc)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             trainable
         )
 
@@ -396,7 +424,7 @@ def make_train_step(
         # replicated-params invariant.
         grad_axes = (DATA_AXIS, MODEL_AXIS) if shard_queue_over_model else DATA_AXIS
         grads = lax.pmean(grads, grad_axes)
-        metrics = {"loss": loss, **topk_accuracy(logits, labels)}
+        metrics = {"loss": loss, **acc}
         metrics = lax.pmean(metrics, DATA_AXIS)
         # Running BN stats: average across devices (strictly better than
         # the reference, which checkpoints rank 0's local stats).

@@ -4,5 +4,16 @@ from moco_tpu.ops.losses import (
     l2_normalize,
     topk_accuracy,
 )
+from moco_tpu.ops.flash_attention import flash_attention, flash_attention_with_lse
+from moco_tpu.ops.fused_infonce import fused_infonce_loss, infonce_stats
 
-__all__ = ["cross_entropy", "infonce_logits", "l2_normalize", "topk_accuracy"]
+__all__ = [
+    "cross_entropy",
+    "infonce_logits",
+    "l2_normalize",
+    "topk_accuracy",
+    "flash_attention",
+    "flash_attention_with_lse",
+    "fused_infonce_loss",
+    "infonce_stats",
+]

@@ -1,0 +1,215 @@
+"""Blockwise (flash) attention as a Pallas TPU kernel.
+
+Why it exists: the reference is a CNN codebase with no attention at all
+(SURVEY.md §5.7); this framework adds the ViT/MoCo-v3 family, and makes
+long sequences first-class. At ViT's 197 tokens XLA's fused attention is
+already fine — this kernel is for the long-sequence regime (high-res
+images, video: thousands of tokens) where materializing the (S, S)
+score matrix blows past VMEM. The classic streaming-softmax recipe
+(Flash Attention; blockwise attention) keeps O(block²) live state:
+running max `m`, running denominator `l`, running numerator `acc`,
+renormalized as each key/value block arrives.
+
+It is also the per-device compute block of ring attention
+(`moco_tpu/parallel/ring_attention.py`): `flash_attention_with_lse`
+returns the (out, logsumexp) pair that lets partial attention results
+from different devices be combined exactly.
+
+Non-causal (ViT is bidirectional); fp32 accumulation regardless of
+input dtype; jnp reference implementation included for testing and as
+the CPU fallback.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def _attn_reference(q, k, v, scale):
+    """Dense jnp reference: (B, H, S, D) -> (out, lse)."""
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    probs = jnp.exp(logits - lse[..., None])
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype), lse
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, scale: float):
+    """One (batch*head, q-block) program: stream all K/V blocks.
+
+    Refs: q (block_q, D); k, v (S, D) — whole K/V in VMEM per program
+    (ring attention keeps S_local small; for single-device long-S the
+    grid could also block K, at the cost of a scratch accumulator).
+    """
+    q = q_ref[...].astype(jnp.float32) * scale
+    seq_k, d = k_ref.shape
+    block_q = q.shape[0]
+
+    def body(start, carry):
+        acc, m_prev, l_prev = carry
+        kb = k_ref[pl.ds(start, block_k), :].astype(jnp.float32)
+        vb = v_ref[pl.ds(start, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (block_q, block_k)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        correction = jnp.exp(m_prev - m_new)
+        l_new = l_prev * correction + jnp.sum(p, axis=-1)
+        acc = acc * correction[:, None] + jax.lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return acc, m_new, l_new
+
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    num_blocks = seq_k // block_k
+
+    acc, m, l = jax.lax.fori_loop(
+        0, num_blocks, lambda i, c: body(i * block_k, c), (acc0, m0, l0)
+    )
+    o_ref[...] = (acc / l[:, None]).astype(o_ref.dtype)
+    lse_ref[...] = m + jnp.log(l)
+
+
+def _flash_forward(
+    q: jax.Array,  # (B, H, S, D)
+    k: jax.Array,
+    v: jax.Array,
+    scale: float,
+    block_q: int,
+    block_k: int,
+    interpret: bool,
+) -> tuple[jax.Array, jax.Array]:
+    b, h, s_q, d = q.shape
+    s_k = k.shape[2]
+    if s_q % block_q or s_k % block_k:
+        # odd sizes (e.g. ViT's 197 tokens): fall back to the dense path
+        return _attn_reference(q, k, v, scale)
+    bh = b * h
+    qr = q.reshape(bh, s_q, d)
+    kr = k.reshape(bh, s_k, d)
+    vr = v.reshape(bh, s_k, d)
+
+    kernel = functools.partial(_flash_kernel, block_k=block_k, scale=scale)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, s_q // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),  # None: squeeze bh
+            pl.BlockSpec((None, s_k, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, s_k, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_q), lambda i, j: (i, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s_q, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, s_q), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(b, h, s_q, d), lse.reshape(b, h, s_q)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention_with_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    scale: Optional[float] = None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """(out, lse) for non-causal attention over (B, H, S, D) inputs.
+
+    `lse[b,h,q] = logsumexp_k(q·k*scale)` — the quantity ring attention
+    needs to merge partial results across devices.
+    """
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    return _flash_forward(q, k, v, scale, block_q, block_k, interpret)
+
+
+def _fwd(q, k, v, scale, block_q, block_k, interpret):
+    scale_ = scale if scale is not None else q.shape[-1] ** -0.5
+    out, lse = _flash_forward(q, k, v, scale_, block_q, block_k, interpret)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _bwd(scale, block_q, block_k, interpret, res, cotangents):
+    """Recompute-based backward, CHUNKED over query blocks: attention
+    probabilities are rebuilt from q, k and the saved lse per (block_q,
+    S_k) tile inside a sequential `lax.map`, so peak memory is
+    O(block_q·S_k) — never the full (S_q, S_k) matrix the forward kernel
+    exists to avoid. dk/dv accumulate across chunks; dq is per-chunk."""
+    q, k, v, out, lse = res
+    g, g_lse = cotangents
+    scale_ = scale if scale is not None else q.shape[-1] ** -0.5
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    outf = out.astype(jnp.float32)
+    g_lse_f = (
+        jnp.zeros(lse.shape, jnp.float32) if g_lse is None else g_lse.astype(jnp.float32)
+    )
+    s_q = q.shape[2]
+
+    def chunk_grads(args):
+        qc, gc, outc, lsec, glsec = args  # (B,H,bq,D) / (B,H,bq)
+        logits = jnp.einsum("bhqd,bhkd->bhqk", qc, kf) * scale_
+        p = jnp.exp(logits - lsec[..., None])  # (B,H,bq,Sk)
+        dv_c = jnp.einsum("bhqk,bhqd->bhkd", p, gc)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", gc, vf)
+        delta = jnp.sum(gc * outc, axis=-1, keepdims=True)
+        # d(lse)/dq flows through p too
+        ds = p * (dp - delta + glsec[..., None])
+        dq_c = jnp.einsum("bhqk,bhkd->bhqd", ds, kf) * scale_
+        dk_c = jnp.einsum("bhqk,bhqd->bhkd", ds, qc) * scale_
+        return dq_c, dk_c, dv_c
+
+    if s_q % block_q or s_q == block_q:  # single chunk / odd sizes: one shot
+        dq, dk, dv = chunk_grads((qf, gf, outf, lse, g_lse_f))
+    else:
+        n_chunks = s_q // block_q
+
+        def to_chunks(x):  # (B,H,Sq,...) -> (n, B,H,bq,...)
+            return jnp.stack(jnp.split(x, n_chunks, axis=2))
+
+        dq_c, dk_c, dv_c = jax.lax.map(
+            chunk_grads,
+            (to_chunks(qf), to_chunks(gf), to_chunks(outf), to_chunks(lse), to_chunks(g_lse_f)),
+        )
+        dq = jnp.concatenate(list(dq_c), axis=2)
+        dk = jnp.sum(dk_c, axis=0)
+        dv = jnp.sum(dv_c, axis=0)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+flash_attention_with_lse.defvjp(_fwd, _bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    scale: Optional[float] = None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool = False,
+) -> jax.Array:
+    """Attention output only; differentiable."""
+    out, _ = flash_attention_with_lse(q, k, v, scale, block_q, block_k, interpret)
+    return out

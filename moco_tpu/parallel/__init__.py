@@ -13,6 +13,7 @@ from moco_tpu.parallel.shuffle import (
     shuffle_gather,
     unshuffle_gather,
 )
+from moco_tpu.parallel.ring_attention import ring_attention
 
 __all__ = [
     "DATA_AXIS",
@@ -26,4 +27,5 @@ __all__ = [
     "balanced_unshuffle",
     "shuffle_gather",
     "unshuffle_gather",
+    "ring_attention",
 ]

@@ -41,6 +41,9 @@ class MocoConfig:
     # Override the ViT patch size (None = the arch's default, 16);
     # small-image tests/smoke configs use 4.
     vit_patch_size: Optional[int] = None
+    # Streaming pallas InfoNCE (no (B, 1+K) logits materialization):
+    # None = auto (on for TPU + replicated tile-divisible queue).
+    fused_infonce: Optional[bool] = None
 
 
 @dataclasses.dataclass(frozen=True)
