@@ -129,7 +129,13 @@ class ImageFolderDataset:
         return arr[y0 : y0 + size, x0 : x0 + size], label
 
 
-def build_dataset(name: str, data_dir: Optional[str], image_size: int, train: bool = True):
+def build_dataset(
+    name: str,
+    data_dir: Optional[str],
+    image_size: int,
+    train: bool = True,
+    num_workers: int = 8,
+):
     if name == "synthetic":
         return SyntheticDataset(image_size=max(image_size, 32))
     if name == "cifar10":
@@ -150,6 +156,8 @@ def build_dataset(name: str, data_dir: Optional[str], image_size: int, train: bo
         if native_available():  # C++ decode pool (native/loader.cc)
             from moco_tpu.data.native_loader import NativeImageFolderDataset
 
-            return NativeImageFolderDataset(root, decode_size=decode_size)
+            return NativeImageFolderDataset(
+                root, decode_size=decode_size, threads=max(num_workers, 1)
+            )
         return ImageFolderDataset(root, decode_size=decode_size)
     raise ValueError(f"unknown dataset {name!r}")

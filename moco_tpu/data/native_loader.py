@@ -2,15 +2,20 @@
 
 `native/loader.cc` replaces the reference's 32 DataLoader worker
 *processes* (`main_moco.py:~L255-260`) with an in-process C++ thread
-pool: file read → libjpeg/libpng decode → bilinear shortest-side resize
-→ center-crop into a caller-owned contiguous uint8 batch, all outside
-the GIL. `NativeImageFolderDataset` is drop-in API-compatible with
-`ImageFolderDataset` (same `load`, plus a batched `load_batch` fast path
-the pipeline prefers when present).
+pool: file read → libjpeg/libpng decode → antialiased bilinear
+shortest-side resize → center-crop into a caller-owned contiguous uint8
+batch, all outside the GIL. `NativeImageFolderDataset` is drop-in
+API-compatible with `ImageFolderDataset` (same `load`, plus a batched
+`load_batch` fast path the pipeline prefers when present).
 
-The library auto-builds via `make` on first use; if the toolchain or
-libjpeg is missing the import fails gracefully and callers fall back to
-the PIL path (`native_available()` to probe).
+Samples the C++ decoders can't handle (webp/bmp/ppm, CMYK JPEGs) are
+retried per-slot through the PIL path — same output geometry — so
+results are host-independent rather than silently zero-filled.
+
+The library auto-builds via `make` on first use, serialized across
+processes with an fcntl lock (multi-host training, pytest-xdist); if the
+toolchain or libjpeg is missing the import fails gracefully and callers
+fall back to the PIL path (`native_available()` to probe).
 """
 
 from __future__ import annotations
@@ -27,6 +32,28 @@ _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(__fil
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libmoco_loader.so")
 _build_lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
+ABI_VERSION = 2
+
+
+def _build_locked() -> None:
+    """Cross-process-safe build: exclusive fcntl lock + re-check, so only
+    one process runs make and nobody dlopens a half-written .so."""
+    import fcntl
+
+    os.makedirs(_NATIVE_DIR, exist_ok=True)
+    lock_path = os.path.join(_NATIVE_DIR, ".build.lock")
+    with open(lock_path, "w") as lockf:
+        fcntl.flock(lockf, fcntl.LOCK_EX)
+        try:
+            if not os.path.exists(_LIB_PATH):
+                subprocess.run(
+                    ["make", "-C", _NATIVE_DIR],
+                    check=True,
+                    capture_output=True,
+                    text=True,
+                )
+        finally:
+            fcntl.flock(lockf, fcntl.LOCK_UN)
 
 
 def _load_lib() -> ctypes.CDLL:
@@ -37,12 +64,7 @@ def _load_lib() -> ctypes.CDLL:
         if _lib is not None:
             return _lib
         if not os.path.exists(_LIB_PATH):
-            subprocess.run(
-                ["make", "-C", _NATIVE_DIR],
-                check=True,
-                capture_output=True,
-                text=True,
-            )
+            _build_locked()
         lib = ctypes.CDLL(_LIB_PATH)
         lib.mtl_create.restype = ctypes.c_void_p
         lib.mtl_create.argtypes = [
@@ -57,10 +79,17 @@ def _load_lib() -> ctypes.CDLL:
             ctypes.POINTER(ctypes.c_int64),
             ctypes.c_int,
             ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_uint8),
         ]
         lib.mtl_destroy.argtypes = [ctypes.c_void_p]
         lib.mtl_version.restype = ctypes.c_int
-        assert lib.mtl_version() == 1
+        if lib.mtl_version() != ABI_VERSION:
+            # stale .so from an older checkout: rebuild once
+            os.remove(_LIB_PATH)
+            _build_locked()
+            lib = ctypes.CDLL(_LIB_PATH)
+            if lib.mtl_version() != ABI_VERSION:
+                raise RuntimeError("native loader ABI mismatch after rebuild")
         _lib = lib
         return lib
 
@@ -82,23 +111,60 @@ class NativeBatchLoader:
         self._handle = self._lib.mtl_create(arr, len(paths), canvas, threads)
         if not self._handle:
             raise RuntimeError("mtl_create failed")
+        self.paths = paths
         self.canvas = canvas
         self.num_paths = len(paths)
 
+    def _pil_fallback(self, path: str) -> Optional[np.ndarray]:
+        """Decode one image through PIL with the same geometry (the
+        ImageFolderDataset.load recipe) for formats the C++ side lacks."""
+        try:
+            from PIL import Image
+
+            size = self.canvas
+            with Image.open(path) as im:
+                im = im.convert("RGB")
+                w, h = im.size
+                s = size / min(w, h)
+                im = im.resize(
+                    (max(size, round(w * s)), max(size, round(h * s))),
+                    resample=Image.BILINEAR,
+                )
+                arr = np.asarray(im, np.uint8)
+            h, w, _ = arr.shape
+            y0, x0 = (h - size) // 2, (w - size) // 2
+            return arr[y0 : y0 + size, x0 : x0 + size]
+        except Exception:
+            return None
+
     def load_batch(self, indices: np.ndarray) -> np.ndarray:
-        """(bs, canvas, canvas, 3) uint8; failed decodes are zero frames."""
+        """(bs, canvas, canvas, 3) uint8. Slots the native decoders fail on
+        are retried via PIL; only doubly-failed slots stay zero."""
         idx = np.ascontiguousarray(indices, dtype=np.int64)
         out = np.empty((len(idx), self.canvas, self.canvas, 3), np.uint8)
+        status = np.empty(len(idx), np.uint8)
         errors = self._lib.mtl_load_batch(
             self._handle,
             idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
             len(idx),
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            status.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
         )
         if errors:
-            import warnings
+            hard_failures = 0
+            for slot in np.nonzero(status == 0)[0]:
+                i = int(idx[slot])
+                img = self._pil_fallback(self.paths[i]) if 0 <= i < self.num_paths else None
+                if img is not None:
+                    out[slot] = img
+                else:
+                    hard_failures += 1
+            if hard_failures:
+                import warnings
 
-            warnings.warn(f"native loader: {errors}/{len(idx)} images failed to decode")
+                warnings.warn(
+                    f"native loader: {hard_failures}/{len(idx)} images failed to decode"
+                )
         return out
 
     def __del__(self):
@@ -129,6 +195,11 @@ class NativeImageFolderDataset:
         return len(self.samples)
 
     def load(self, index: int, decode_size: Optional[int] = None) -> tuple[np.ndarray, int]:
+        if decode_size is not None and decode_size != self.decode_size:
+            raise ValueError(
+                f"native loader decodes at the fixed canvas {self.decode_size}; "
+                f"got decode_size={decode_size} (use ImageFolderDataset for variable sizes)"
+            )
         img = self._loader.load_batch(np.asarray([index]))[0]
         return img, int(self._labels[index])
 

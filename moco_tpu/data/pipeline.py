@@ -84,7 +84,11 @@ class _HostPipeline:
         self.mesh = mesh
         self.seed = seed
         self.dataset = dataset or build_dataset(
-            config.dataset, config.data_dir, config.image_size, train=train
+            config.dataset,
+            config.data_dir,
+            config.image_size,
+            train=train,
+            num_workers=config.num_workers,
         )
         self.batch_size = config.global_batch
         if drop_last and len(self.dataset) < self.batch_size:

@@ -25,6 +25,7 @@
 #include <algorithm>
 #include <atomic>
 #include <condition_variable>
+#include <cmath>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -190,8 +191,10 @@ ResampleWeights triangle_weights(int in_size, int out_size) {
 // (moco_tpu/data/datasets.py) with PIL BILINEAR.
 void resize_center_crop(const Image& src, int canvas, uint8_t* out) {
   const double scale = double(canvas) / std::min(src.w, src.h);
-  const int nw = std::max(canvas, int(src.w * scale + 0.5));
-  const int nh = std::max(canvas, int(src.h * scale + 0.5));
+  // lrint (ties-to-even) matches Python round() in datasets.py — a plain
+  // int(x + 0.5) would diverge by 1px on exact-half products
+  const int nw = std::max(canvas, int(std::lrint(src.w * scale)));
+  const int nh = std::max(canvas, int(std::lrint(src.h * scale)));
   ResampleWeights wx = triangle_weights(src.w, nw);
   ResampleWeights wy = triangle_weights(src.h, nh);
 
@@ -264,6 +267,7 @@ class Loader {
     const int64_t* indices;
     int bs;
     uint8_t* out;
+    uint8_t* status;  // per-slot: 1 = ok, 0 = failed (caller falls back)
     std::atomic<int> next{0}, errors{0}, done{0};
   };
 
@@ -271,7 +275,7 @@ class Loader {
   // number of failed loads (failed slots are zero-filled). The shared_ptr
   // keeps the batch context alive for any worker still draining it after
   // this call returns.
-  int load_batch(const int64_t* indices, int bs, uint8_t* out) {
+  int load_batch(const int64_t* indices, int bs, uint8_t* out, uint8_t* status) {
     // one batch at a time per handle: concurrent callers (e.g. a Python
     // thread pool mapping single-image loads) would otherwise race on
     // the batch_ slot
@@ -280,6 +284,7 @@ class Loader {
     ctx->indices = indices;
     ctx->bs = bs;
     ctx->out = out;
+    ctx->status = status;
     {
       std::lock_guard<std::mutex> lk(mu_);
       batch_ = ctx;
@@ -324,7 +329,9 @@ class Loader {
       int i = ctx->next.fetch_add(1);
       if (i >= ctx->bs) break;
       uint8_t* dst = ctx->out + i * frame;
-      if (!load_one(ctx->indices[i], dst)) {
+      bool ok = load_one(ctx->indices[i], dst);
+      if (ctx->status) ctx->status[i] = ok ? 1 : 0;
+      if (!ok) {
         memset(dst, 0, frame);
         ctx->errors.fetch_add(1);
       }
@@ -369,12 +376,13 @@ void* mtl_create(const char** paths, int64_t n, int canvas, int threads) {
   return new Loader(std::move(v), canvas, threads);
 }
 
-int mtl_load_batch(void* handle, const int64_t* indices, int bs, uint8_t* out) {
-  return static_cast<Loader*>(handle)->load_batch(indices, bs, out);
+int mtl_load_batch(void* handle, const int64_t* indices, int bs, uint8_t* out,
+                   uint8_t* status) {
+  return static_cast<Loader*>(handle)->load_batch(indices, bs, out, status);
 }
 
 void mtl_destroy(void* handle) { delete static_cast<Loader*>(handle); }
 
-int mtl_version() { return 1; }
+int mtl_version() { return 2; }
 
 }  // extern "C"

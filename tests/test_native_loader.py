@@ -80,6 +80,34 @@ def test_out_of_range_index_zero_fills(image_dir):
     assert out[0].std() > 0
 
 
+def test_unsupported_format_falls_back_to_pil(tmp_path):
+    """Formats the C++ decoders lack (bmp) retry through PIL per slot —
+    never silently-black frames."""
+    root = tmp_path / "tree"
+    (root / "a").mkdir(parents=True)
+    rng = np.random.default_rng(3)
+    arr = rng.integers(0, 256, (40, 56, 3), dtype=np.uint8)
+    Image.fromarray(arr).save(root / "a" / "img.bmp")
+    Image.fromarray(arr).save(root / "a" / "img.jpg", quality=95)
+    nat = NativeImageFolderDataset(str(root), decode_size=32)
+    from moco_tpu.data.datasets import ImageFolderDataset
+
+    py = ImageFolderDataset(str(root), decode_size=32)
+    for i in range(len(nat)):
+        b, _ = nat.load(i)
+        a, _ = py.load(i)
+        assert b.std() > 5, "fallback produced a blank frame"
+        diff = np.abs(a.astype(np.float32) - b.astype(np.float32)).mean()
+        assert diff < 6.0
+
+
+def test_decode_size_override_rejected(image_dir):
+    root, _ = image_dir
+    nat = NativeImageFolderDataset(root, decode_size=32)
+    with pytest.raises(ValueError, match="fixed canvas"):
+        nat.load(0, decode_size=64)
+
+
 def test_labels_match_folder_classes(image_dir):
     root, _ = image_dir
     nat = NativeImageFolderDataset(root, decode_size=16)
