@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Detection fine-tuning harness — the reference's `detection/train_net.py`
+(SURVEY.md §2.2 row 12, ~80 LoC): a thin Detectron2 `DefaultTrainer`
+whose only customization is evaluator selection (PascalVOC vs COCO).
+
+Runs on GPU with detectron2 installed (not in the TPU image — this file
+is the bridge's far side; `convert_pretrain.py` produces the weights it
+consumes)."""
+
+from __future__ import annotations
+
+import os
+
+try:
+    import detectron2.utils.comm as comm
+    from detectron2.checkpoint import DetectionCheckpointer
+    from detectron2.config import get_cfg
+    from detectron2.engine import DefaultTrainer, default_argument_parser, default_setup, launch
+    from detectron2.evaluation import COCOEvaluator, PascalVOCDetectionEvaluator
+    from detectron2.layers import get_norm
+except ImportError as e:  # pragma: no cover - detectron2 is GPU-side only
+    raise SystemExit(
+        "detectron2 is required for detection fine-tuning (GPU side). "
+        "Install it per https://github.com/facebookresearch/detectron2 — "
+        f"import failed with: {e}"
+    )
+
+
+class Trainer(DefaultTrainer):
+    """DefaultTrainer + dataset-appropriate evaluator, as the reference."""
+
+    @classmethod
+    def build_evaluator(cls, cfg, dataset_name, output_folder=None):
+        if output_folder is None:
+            output_folder = os.path.join(cfg.OUTPUT_DIR, "inference")
+        if "voc" in dataset_name:
+            return PascalVOCDetectionEvaluator(dataset_name)
+        return COCOEvaluator(dataset_name, output_dir=output_folder)
+
+
+def setup(args):
+    cfg = get_cfg()
+    cfg.merge_from_file(args.config_file)
+    cfg.merge_from_list(args.opts)
+    cfg.freeze()
+    default_setup(cfg, args)
+    return cfg
+
+
+def main(args):
+    cfg = setup(args)
+    if args.eval_only:
+        model = Trainer.build_model(cfg)
+        DetectionCheckpointer(model, save_dir=cfg.OUTPUT_DIR).resume_or_load(
+            cfg.MODEL.WEIGHTS, resume=args.resume
+        )
+        return Trainer.test(cfg, model)
+    trainer = Trainer(cfg)
+    trainer.resume_or_load(resume=args.resume)
+    return trainer.train()
+
+
+if __name__ == "__main__":
+    args = default_argument_parser().parse_args()
+    launch(
+        main,
+        args.num_gpus,
+        num_machines=args.num_machines,
+        machine_rank=args.machine_rank,
+        dist_url=args.dist_url,
+        args=(args,),
+    )

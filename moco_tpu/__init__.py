@@ -17,3 +17,18 @@ linear-probe evaluation, and detection-transfer export — built TPU-first:
 """
 
 __version__ = "0.1.0"
+
+
+def __getattr__(name):
+    # Lazy top-level API (no jax import at package-import time).
+    # NB: `train` is NOT aliased here — `moco_tpu.train` must always
+    # mean the submodule.
+    if name == "train_lincls":
+        from moco_tpu.lincls import train_lincls
+
+        return train_lincls
+    if name == "knn_eval":
+        from moco_tpu.knn import knn_eval
+
+        return knn_eval
+    raise AttributeError(f"module 'moco_tpu' has no attribute {name!r}")

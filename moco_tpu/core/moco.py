@@ -261,6 +261,14 @@ def make_train_step(
         )
         return out, mut["batch_stats"]
 
+    # Rematerialization: recompute the query forward during backward
+    # instead of keeping every activation live (SURVEY.md hard-part 6 /
+    # the HBM-vs-FLOPs trade). Key-side forwards carry no gradient, so
+    # only the grad-bearing query apply is wrapped.
+    grad_apply_encoder = (
+        jax.checkpoint(lambda p, s, x: apply_encoder(p, s, x)) if cfg.remat else apply_encoder
+    )
+
     def apply_predictor(params, batch_stats, x, train=True):
         out, mut = predictor.apply(
             {"params": params, "batch_stats": batch_stats},
@@ -294,7 +302,7 @@ def make_train_step(
             return 2.0 * cfg.temperature * cross_entropy(logits, labels), logits
 
         def loss_fn(trainable):
-            feats, stats_q = apply_encoder(trainable["enc"], state.batch_stats_q, x_cat)
+            feats, stats_q = grad_apply_encoder(trainable["enc"], state.batch_stats_q, x_cat)
             preds, stats_pred = apply_predictor(
                 trainable["pred"], state.batch_stats_pred, feats
             )
@@ -379,7 +387,7 @@ def make_train_step(
 
         # (3) Query forward + InfoNCE loss (moco/builder.py:~L128-161).
         def loss_fn(trainable):
-            q, stats_q = apply_encoder(trainable["enc"], state.batch_stats_q, im_q)
+            q, stats_q = grad_apply_encoder(trainable["enc"], state.batch_stats_q, im_q)
             q = l2_normalize(q)
             if cfg.num_negatives and use_fused:
                 # streaming pallas kernel: never materializes (B, 1+K)

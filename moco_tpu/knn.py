@@ -1,0 +1,108 @@
+"""kNN evaluation monitor.
+
+The reference's only quality signals are the per-step (K+1)-way contrast
+accuracy and the full linear probe (SURVEY.md §4) — the probe costs 100
+epochs of training. The standard cheap middle ground in the SSL
+literature (Wu et al. instance discrimination; used by every MoCo
+reproduction) is weighted-kNN on frozen backbone features: no training,
+minutes not hours, correlates well with probe top-1. This gives the
+rebuild an early-warning metric the reference lacks.
+
+Classifier: cosine-similarity kNN with temperature-weighted voting —
+    score(class c) = Σ_{i ∈ topk} 1[y_i = c] · exp(sim_i / T)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from moco_tpu.ops.losses import l2_normalize
+
+
+def extract_features(
+    backbone, params, batch_stats, dataset, batch_size: int = 256, image_size: Optional[int] = None
+) -> tuple[np.ndarray, np.ndarray]:
+    """L2-normalized backbone features + labels for a whole dataset.
+    Center-crop-free: datasets decode to a fixed canvas already."""
+    from moco_tpu.data.augment import get_recipe, normalize
+
+    recipe = get_recipe(False, image_size or 224)
+
+    @jax.jit
+    def forward(raw):
+        x = raw.astype(jnp.float32) / 255.0
+        x = normalize(x, recipe.mean, recipe.std)
+        feats = backbone.apply(
+            {"params": params, "batch_stats": batch_stats}, x, train=False
+        )
+        return l2_normalize(feats)
+
+    feats_out, labels_out = [], []
+    n = len(dataset)
+    for start in range(0, n, batch_size):
+        idx = np.arange(start, min(start + batch_size, n))
+        if hasattr(dataset, "load_batch"):
+            raw, labels = dataset.load_batch(idx)
+        else:
+            loads = [dataset.load(int(i)) for i in idx]
+            raw = np.stack([im for im, _ in loads])
+            labels = np.asarray([l for _, l in loads], np.int32)
+        feats_out.append(np.asarray(forward(jnp.asarray(raw))))
+        labels_out.append(np.asarray(labels, np.int32))
+    return np.concatenate(feats_out), np.concatenate(labels_out)
+
+
+def knn_classify(
+    train_feats: np.ndarray,  # (N, C) L2-normalized
+    train_labels: np.ndarray,  # (N,)
+    test_feats: np.ndarray,  # (M, C)
+    num_classes: int,
+    k: int = 200,
+    temperature: float = 0.07,
+    batch_size: int = 512,
+) -> np.ndarray:
+    """Predicted labels for test_feats via temperature-weighted kNN."""
+    k = min(k, train_feats.shape[0])
+    bank = jnp.asarray(train_feats)
+    bank_labels = jnp.asarray(train_labels)
+
+    @jax.jit
+    def classify(q):
+        sims = q @ bank.T  # (m, N) cosine (inputs are normalized)
+        top_sims, top_idx = jax.lax.top_k(sims, k)
+        weights = jnp.exp(top_sims / temperature)  # (m, k)
+        votes = jax.nn.one_hot(bank_labels[top_idx], num_classes)  # (m, k, C)
+        scores = jnp.einsum("mk,mkc->mc", weights, votes)
+        return jnp.argmax(scores, axis=-1)
+
+    preds = []
+    for start in range(0, test_feats.shape[0], batch_size):
+        preds.append(np.asarray(classify(jnp.asarray(test_feats[start : start + batch_size]))))
+    return np.concatenate(preds)
+
+
+def knn_eval(
+    backbone,
+    params,
+    batch_stats,
+    train_dataset,
+    test_dataset,
+    num_classes: int,
+    k: int = 200,
+    temperature: float = 0.07,
+    batch_size: int = 256,
+    image_size: Optional[int] = None,
+) -> float:
+    """kNN top-1 (%) of frozen features — the cheap probe proxy."""
+    train_f, train_y = extract_features(
+        backbone, params, batch_stats, train_dataset, batch_size, image_size
+    )
+    test_f, test_y = extract_features(
+        backbone, params, batch_stats, test_dataset, batch_size, image_size
+    )
+    preds = knn_classify(train_f, train_y, test_f, num_classes, k, temperature)
+    return float(100.0 * np.mean(preds == test_y))

@@ -3,6 +3,8 @@ from moco_tpu.parallel.mesh import (
     MODEL_AXIS,
     batch_sharding,
     create_mesh,
+    create_multislice_mesh,
+    initialize_multihost,
     replicated_sharding,
     shard_batch,
 )
@@ -20,6 +22,8 @@ __all__ = [
     "MODEL_AXIS",
     "batch_sharding",
     "create_mesh",
+    "create_multislice_mesh",
+    "initialize_multihost",
     "replicated_sharding",
     "shard_batch",
     "make_permutation",

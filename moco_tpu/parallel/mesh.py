@@ -45,6 +45,61 @@ def create_mesh(
     return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
 
 
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Multi-host rendezvous — the TPU-native replacement for the
+    reference's `dist.init_process_group(nccl, dist_url, world_size,
+    rank)` (`main_moco.py:~L150`, SURVEY.md §2.4).
+
+    On Cloud TPU pods all arguments are discovered from the environment
+    (call with no args, once per host, before any jax op); elsewhere pass
+    them explicitly. After this, `jax.devices()` spans every host and the
+    same `create_mesh`/`create_multislice_mesh` code covers the pod.
+    """
+    import jax
+
+    # pass each argument through independently — jax.distributed.initialize
+    # auto-detects whichever are None (dropping explicit num_processes/
+    # process_id just because the address is auto-detected would silently
+    # build the wrong world)
+    kwargs = {
+        k: v
+        for k, v in dict(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        ).items()
+        if v is not None
+    }
+    jax.distributed.initialize(**kwargs)
+
+
+def create_multislice_mesh(num_model: int = 1) -> Mesh:
+    """(data, model) mesh for a multi-slice deployment: the data axis
+    spans DCN (across slices) x ICI (within a slice), so gradient psum
+    does its ring reduction over ICI first and only the per-slice partial
+    crosses DCN — the layout 'How to Scale Your Model' prescribes for
+    pure data parallelism across slices."""
+    from jax.experimental import mesh_utils
+
+    devices = jax.devices()
+    num_slices = max(getattr(d, "slice_index", 0) for d in devices) + 1
+    if num_slices == 1:
+        return create_mesh(num_model=num_model)
+    per_slice = len(devices) // num_slices
+    if per_slice % num_model:
+        raise ValueError(f"{per_slice} chips/slice not divisible by model={num_model}")
+    arr = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=(per_slice // num_model, num_model),
+        dcn_mesh_shape=(num_slices, 1),
+        devices=devices,
+    )
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Batch dimension sharded over the data axis, rest replicated."""
     return NamedSharding(mesh, P(DATA_AXIS))

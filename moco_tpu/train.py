@@ -15,6 +15,7 @@ Library entry: `train(config) -> final metrics`. CLI: repo-root
 
 from __future__ import annotations
 
+import signal
 import time
 from typing import Optional
 
@@ -24,7 +25,7 @@ import numpy as np
 
 from moco_tpu.core import build_encoder, build_predictor, create_state, make_train_step, place_state
 from moco_tpu.data.pipeline import TwoCropPipeline
-from moco_tpu.parallel import create_mesh
+from moco_tpu.parallel import create_mesh, create_multislice_mesh
 from moco_tpu.utils.checkpoint import CheckpointManager
 from moco_tpu.utils.config import TrainConfig, config_to_dict
 from moco_tpu.utils.metrics import AverageMeter, MetricWriter, ProgressMeter, profiler_trace
@@ -41,9 +42,14 @@ def train(
     `dataset` overrides the config-built dataset (tests inject synthetic
     data of a chosen size this way).
     """
-    mesh = create_mesh(
-        num_data=config.parallel.num_data, num_model=config.parallel.num_model
-    )
+    if config.parallel.num_data is None:
+        # slice-aware layout: on multi-slice deployments the data axis
+        # orders ICI-adjacent chips together so grad psum rides ICI first
+        mesh = create_multislice_mesh(num_model=config.parallel.num_model)
+    else:
+        mesh = create_mesh(
+            num_data=config.parallel.num_data, num_model=config.parallel.num_model
+        )
     num_data = mesh.shape["data"]
 
     pipeline = TwoCropPipeline(config.data, mesh, seed=config.seed, dataset=dataset)
@@ -61,9 +67,10 @@ def train(
     sample = jnp.zeros((1, config.data.image_size, config.data.image_size, 3), jnp.float32)
     state = create_state(init_rng, config, encoder, tx, sample, predictor=predictor)
 
-    ckpt = CheckpointManager(
-        config.workdir, keep=3, save_interval=config.checkpoint_every_epochs
-    )
+    # Checkpoint ids are the GLOBAL STEP (unique and monotonic even for
+    # mid-epoch preemption saves); the epoch lives in extras. Save
+    # frequency is gated here in the driver, not by Orbax's policy.
+    ckpt = CheckpointManager(config.workdir, keep=3, save_interval=1)
     start_epoch = 0
     if ckpt.latest_step() is not None:  # --resume semantics, automatic
         state, extra = ckpt.restore(state)
@@ -85,56 +92,107 @@ def train(
         shuffle_rng, jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
     )
 
+    # Graceful preemption (TPU VMs are frequently preemptible, typically
+    # with a ~30 s SIGTERM grace window): the flag is checked inside the
+    # STEP loop, so the save happens within seconds, not at the end of a
+    # multi-minute epoch. A second SIGINT raises KeyboardInterrupt so
+    # Ctrl-C can always actually stop the process. The reference's
+    # failure story is "NCCL hangs, restart by hand with --resume"
+    # (SURVEY.md §5.3).
+    preempted = {"count": 0}
+
+    def _handle(signum, frame):
+        preempted["count"] += 1
+        if signum == signal.SIGINT and preempted["count"] > 1:
+            raise KeyboardInterrupt
+        print(f"signal {signum}: checkpointing at the next step, then exiting")
+
+    prev_handlers = {}
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            prev_handlers[sig] = signal.signal(sig, _handle)
+        except ValueError:  # not the main thread (tests)
+            pass
+
     writer = MetricWriter(config.workdir)
     last_avg: dict = {}
-    with profiler_trace(profile_dir):
-        for epoch in range(start_epoch, config.optim.epochs):
-            batch_time = AverageMeter("Time", ":6.3f")
-            data_time = AverageMeter("Data", ":6.3f")
-            losses = AverageMeter("Loss", ":.4e")
-            top1 = AverageMeter("Acc@1", ":6.2f")
-            top5 = AverageMeter("Acc@5", ":6.2f")
-            progress = ProgressMeter(
-                steps_per_epoch,
-                [batch_time, data_time, losses, top1, top5],
-                prefix=f"Epoch: [{epoch}]",
-            )
-            end = time.perf_counter()
-            for i, batch in enumerate(pipeline.epoch(epoch)):
-                if i >= steps_per_epoch:
-                    break
-                data_time.update(time.perf_counter() - end)
-                state, metrics = step_fn(state, batch, root_rng)
-                if i % config.log_every == 0 or i == steps_per_epoch - 1:
-                    # host sync only on log steps — keeps the device queue full
-                    m = {k: float(v) for k, v in metrics.items()}
-                    bs = config.data.global_batch
-                    losses.update(m["loss"], bs)
-                    top1.update(m["acc1"], bs)
-                    top5.update(m["acc5"], bs)
-                    batch_time.update(time.perf_counter() - end)
-                    progress.display(i)
-                    writer.write(
+    try:
+        with profiler_trace(profile_dir):
+            for epoch in range(start_epoch, config.optim.epochs):
+                batch_time = AverageMeter("Time", ":6.3f")
+                data_time = AverageMeter("Data", ":6.3f")
+                losses = AverageMeter("Loss", ":.4e")
+                top1 = AverageMeter("Acc@1", ":6.2f")
+                top5 = AverageMeter("Acc@5", ":6.2f")
+                progress = ProgressMeter(
+                    steps_per_epoch,
+                    [batch_time, data_time, losses, top1, top5],
+                    prefix=f"Epoch: [{epoch}]",
+                )
+                end = time.perf_counter()
+                stop_now = False
+                for i, batch in enumerate(pipeline.epoch(epoch)):
+                    if i >= steps_per_epoch:
+                        break
+                    data_time.update(time.perf_counter() - end)
+                    state, metrics = step_fn(state, batch, root_rng)
+                    if preempted["count"]:
+                        stop_now = True
+                        break
+                    if i % config.log_every == 0 or i == steps_per_epoch - 1:
+                        # host sync only on log steps — keeps the device queue full
+                        m = {k: float(v) for k, v in metrics.items()}
+                        bs = config.data.global_batch
+                        losses.update(m["loss"], bs)
+                        top1.update(m["acc1"], bs)
+                        top5.update(m["acc5"], bs)
+                        batch_time.update(time.perf_counter() - end)
+                        progress.display(i)
+                        writer.write(
+                            int(state.step),
+                            {
+                                "epoch": epoch,
+                                "lr": float(lr_schedule(int(state.step) - 1)),
+                                **m,
+                            },
+                        )
+                    end = time.perf_counter()
+                last_avg = {
+                    "epoch": epoch,
+                    "loss": losses.avg,
+                    "acc1": top1.avg,
+                    "acc5": top5.avg,
+                }
+                # A mid-epoch preemption save records the PREVIOUS epoch
+                # as completed, so resume redoes this partial epoch from
+                # its start (same granularity the reference's per-epoch
+                # checkpoints give a crash, but without losing the work
+                # to a SIGKILL: the save happens within one step of the
+                # signal, inside a preemption grace window).
+                completed_epoch = epoch - 1 if stop_now else epoch
+                due = (
+                    stop_now
+                    or epoch == config.optim.epochs - 1
+                    or epoch % config.checkpoint_every_epochs == 0
+                )
+                if due:
+                    ckpt.save(
                         int(state.step),
-                        {
-                            "epoch": epoch,
-                            "lr": float(lr_schedule(int(state.step) - 1)),
-                            **m,
+                        state,
+                        extra={
+                            "epoch": completed_epoch,
+                            "config": config_to_dict(config),
                         },
                     )
-                end = time.perf_counter()
-            last_avg = {
-                "epoch": epoch,
-                "loss": losses.avg,
-                "acc1": top1.avg,
-                "acc5": top5.avg,
-            }
-            ckpt.save(
-                epoch,
-                state,
-                extra={"epoch": epoch, "config": config_to_dict(config)},
-                force=epoch == config.optim.epochs - 1,  # never skip the last
-            )
-    writer.close()
-    ckpt.close()
+                if stop_now:
+                    print(
+                        f"preempted mid-epoch {epoch}: state saved at step "
+                        f"{int(state.step)}; resume will redo epoch {epoch}"
+                    )
+                    break
+    finally:
+        writer.close()
+        ckpt.close()
+        for sig, h in prev_handlers.items():
+            signal.signal(sig, h)
     return last_avg

@@ -44,6 +44,10 @@ class MocoConfig:
     # Streaming pallas InfoNCE (no (B, 1+K) logits materialization):
     # None = auto (on for TPU + replicated tile-divisible queue).
     fused_infonce: Optional[bool] = None
+    # Rematerialize the query-encoder forward in the backward pass
+    # (jax.checkpoint): trades ~30% more FLOPs for O(depth) less
+    # activation HBM — for big models / big per-chip batches.
+    remat: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

@@ -72,6 +72,33 @@ def test_train_resumes_from_checkpoint(trained):
     assert result["epoch"] == 2
 
 
+def test_sigterm_checkpoints_and_exits_cleanly(tmp_path):
+    """Preemption: SIGTERM mid-training -> save within a step, clean
+    return, resumable state; original handlers restored afterwards."""
+    import os
+    import signal
+    import threading
+
+    from moco_tpu.train import train
+    from moco_tpu.utils.checkpoint import CheckpointManager
+
+    config = _tiny_config(tmp_path / "preempt", epochs=50, shuffle="none")
+    dataset = SyntheticDataset(num_examples=64, image_size=16)
+    before_handler = signal.getsignal(signal.SIGTERM)
+    timer = threading.Timer(6.0, lambda: os.kill(os.getpid(), signal.SIGTERM))
+    timer.start()
+    try:
+        train(config, dataset=dataset)  # returns early instead of dying
+    finally:
+        timer.cancel()
+    assert signal.getsignal(signal.SIGTERM) is before_handler
+    mgr = CheckpointManager(str(config.workdir))
+    assert mgr.latest_step() is not None
+    extra = mgr.read_extra()
+    assert extra["epoch"] < 49  # exited before finishing all 50 epochs
+    mgr.close()
+
+
 def test_cli_maps_reference_flags(tmp_path):
     import train as cli
 
