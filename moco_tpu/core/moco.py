@@ -94,14 +94,20 @@ def create_backbone(cfg: MocoConfig, num_data: Optional[int] = None) -> nn.Modul
 
 
 def build_encoder(cfg: MocoConfig, num_data: Optional[int] = None) -> MoCoEncoder:
-    """Backbone + projection head. Head choice is independent of backbone
-    family: v3 gets the 3-layer SyncBN MLP (arXiv:2104.02057 — its R50
-    runs use it too), v1/v2 the reference's Linear / 2-layer MLP."""
+    """Backbone + projection head. v3 head shape branches on backbone
+    family, matching upstream `moco-v3`'s per-family builders
+    (`_build_projector_and_predictor_mlps`): ViT gets the 3-layer
+    projector, ResNet the 2-layer one (both end in affine-free BN);
+    v1/v2 get the reference's Linear / 2-layer MLP
+    (`moco/builder.py:~L20-30`)."""
     dtype = jnp.dtype(cfg.compute_dtype)
     backbone = create_backbone(cfg, num_data=num_data)
     if cfg.v3:
         axis = DATA_AXIS if (num_data or 1) > 1 else None
-        head = V3MLPHead(num_layers=3, dim=cfg.dim, cross_replica_axis=axis, dtype=dtype)
+        num_layers = 3 if cfg.arch.startswith("vit") else 2
+        head = V3MLPHead(
+            num_layers=num_layers, dim=cfg.dim, cross_replica_axis=axis, dtype=dtype
+        )
     else:
         head = ProjectionHead(dim=cfg.dim, mlp=cfg.mlp, dtype=dtype)
     return MoCoEncoder(backbone=backbone, head=head)
@@ -109,7 +115,9 @@ def build_encoder(cfg: MocoConfig, num_data: Optional[int] = None) -> MoCoEncode
 
 def build_predictor(cfg: MocoConfig, num_data: Optional[int] = None) -> Optional[nn.Module]:
     """v3's prediction MLP on the query side only (2-layer BN-MLP); None
-    for v1/v2, whose query and key encoders are architecturally identical."""
+    for v1/v2, whose query and key encoders are architecturally identical.
+    The ViT predictor keeps the final affine-free BN; the ResNet one drops
+    it (upstream `MoCo_ResNet` passes last_bn=False)."""
     if not cfg.v3:
         return None
     axis = DATA_AXIS if (num_data or 1) > 1 else None
@@ -117,6 +125,7 @@ def build_predictor(cfg: MocoConfig, num_data: Optional[int] = None) -> Optional
         num_layers=2,
         dim=cfg.dim,
         cross_replica_axis=axis,
+        last_bn=cfg.arch.startswith("vit"),
         dtype=jnp.dtype(cfg.compute_dtype),
     )
 
@@ -223,7 +232,10 @@ def make_train_step(
         """Constant m, or moco-v3's cosine ramp m -> 1.0 over training."""
         if not cfg.momentum_cos:
             return cfg.momentum
-        frac = step.astype(jnp.float32) / total_steps
+        # Clamp: a mid-epoch preemption resume can replay steps past
+        # total_steps; without the clip cos(pi*frac) passes -1 and the
+        # EMA momentum would ramp back DOWN from 1.0.
+        frac = jnp.clip(step.astype(jnp.float32) / total_steps, 0.0, 1.0)
         return 1.0 - (1.0 - cfg.momentum) * 0.5 * (1.0 + jnp.cos(jnp.pi * frac))
     n_data = mesh.shape[DATA_AXIS]
     n_model = mesh.shape.get(MODEL_AXIS, 1)
@@ -239,15 +251,16 @@ def make_train_step(
     # Fused streaming InfoNCE (pallas): auto-on for a TPU backend with a
     # replicated, tile-divisible queue; explicit True forces it (interpret
     # mode off-TPU), False forces the dense logits path.
+    from moco_tpu.ops.fused_infonce import DEFAULT_BLOCK_K
+
+    fused_block_k = cfg.fused_block_k or DEFAULT_BLOCK_K
     use_fused = cfg.fused_infonce
     if use_fused is None:
-        from moco_tpu.ops.fused_infonce import DEFAULT_BLOCK_K
-
         use_fused = (
             jax.default_backend() == "tpu"
             and not (shard_queue_over_model or n_model > 1)
             and cfg.num_negatives > 0
-            and cfg.num_negatives % DEFAULT_BLOCK_K == 0
+            and cfg.num_negatives % fused_block_k == 0
         )
     if use_fused and shard_queue_over_model:
         raise ValueError("fused_infonce does not support a model-sharded queue")
@@ -398,6 +411,7 @@ def make_train_step(
                     k_local,
                     state.queue,
                     cfg.temperature,
+                    block_k=fused_block_k,
                     interpret=jax.default_backend() != "tpu",
                 )
             elif cfg.num_negatives:

@@ -185,6 +185,7 @@ class NativeImageFolderDataset:
         py = ImageFolderDataset(root, decode_size=decode_size)
         self.samples = py.samples
         self.class_to_idx = py.class_to_idx
+        self.num_classes = py.num_classes
         self.decode_size = decode_size
         self._labels = np.asarray([l for _, l in py.samples], np.int32)
         self._loader = NativeBatchLoader(

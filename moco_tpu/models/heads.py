@@ -43,13 +43,20 @@ class V3MLPHead(nn.Module):
     `cross_replica_axis` makes the BN a SyncBN over the mesh's data axis
     (the paper trains with SyncBN in the heads).
 
-    3 layers / hidden 4096 / out 256 = projection; 2 layers = prediction.
+    Layer counts / final-BN follow upstream `moco-v3`'s per-family
+    builders (`moco/builder.py` `MoCo_ResNet`/`MoCo_ViT`
+    `_build_projector_and_predictor_mlps`):
+      - ViT:    projector = 3 layers, predictor = 2 layers, both ending
+                in the affine-free output BN (`last_bn=True`);
+      - ResNet: projector = 2 layers with output BN, predictor =
+                2 layers WITHOUT the final BN (`last_bn=False`).
     """
 
     num_layers: int = 3
     hidden_dim: int = 4096
     dim: int = 256
     cross_replica_axis: str | None = None
+    last_bn: bool = True
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -68,7 +75,8 @@ class V3MLPHead(nn.Module):
             x = norm()(x)
             x = nn.relu(x)
         x = nn.Dense(self.dim, use_bias=False, dtype=self.dtype)(x)
-        x = norm(use_bias=False, use_scale=False)(x)
+        if self.last_bn:
+            x = norm(use_bias=False, use_scale=False)(x)
         return x.astype(jnp.float32)
 
 

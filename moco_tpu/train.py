@@ -36,11 +36,14 @@ def train(
     config: TrainConfig,
     dataset=None,
     profile_dir: Optional[str] = None,
+    knn_datasets=None,
 ) -> dict:
     """Run the full pretraining loop; returns the last epoch's mean metrics.
 
     `dataset` overrides the config-built dataset (tests inject synthetic
-    data of a chosen size this way).
+    data of a chosen size this way). `knn_datasets` is an optional
+    (bank_dataset, test_dataset) pair for the periodic kNN monitor
+    (config.knn_every_epochs); when None it is built from config.data.
     """
     if config.parallel.num_data is None:
         # slice-aware layout: on multi-slice deployments the data axis
@@ -114,6 +117,56 @@ def train(
         except ValueError:  # not the main thread (tests)
             pass
 
+    # kNN monitor setup (config.knn_every_epochs > 0): frozen-backbone
+    # weighted kNN as the cheap probe proxy (moco_tpu/knn.py docstring).
+    knn_pair = knn_datasets
+    if config.knn_every_epochs and knn_pair is None:
+        from moco_tpu.data.datasets import build_dataset
+
+        knn_pair = (
+            build_dataset(
+                config.data.dataset, config.data.data_dir, config.data.image_size, train=True
+            ),
+            build_dataset(
+                config.data.dataset, config.data.data_dir, config.data.image_size, train=False
+            ),
+        )
+
+    # num_classes once at setup: every in-repo dataset exposes it; for a
+    # foreign injected dataset scan ALL labels (a first-N scan would
+    # under-count on class-sorted layouts like ImageFolder and silently
+    # zero out the one_hot votes for the missed classes).
+    knn_num_classes = None
+    if knn_pair is not None:
+        bank = knn_pair[0]
+        knn_num_classes = getattr(bank, "num_classes", None) or int(
+            np.max([bank.load(i)[1] for i in range(len(bank))]) + 1
+        )
+
+    def run_knn(epoch: int) -> Optional[float]:
+        if not (config.knn_every_epochs and knn_pair):
+            return None
+        last = epoch == config.optim.epochs - 1
+        if epoch % config.knn_every_epochs and not last:
+            return None
+        from moco_tpu.knn import knn_eval
+
+        bank, test = knn_pair
+        num_classes = knn_num_classes
+        top1 = knn_eval(
+            encoder.backbone,
+            state.params_q["backbone"],
+            state.batch_stats_q.get("backbone", {}),
+            bank,
+            test,
+            num_classes=num_classes,
+            k=min(config.knn_k, len(bank)),
+            temperature=config.knn_temperature,
+            image_size=config.data.image_size,
+        )
+        print(f"Epoch [{epoch}] kNN top-1: {top1:.2f}%")
+        return top1
+
     writer = MetricWriter(config.workdir)
     last_avg: dict = {}
     try:
@@ -163,6 +216,11 @@ def train(
                     "acc1": top1.avg,
                     "acc5": top5.avg,
                 }
+                if not stop_now:
+                    knn_top1 = run_knn(epoch)
+                    if knn_top1 is not None:
+                        last_avg["knn_top1"] = knn_top1
+                        writer.write(int(state.step), {"epoch": epoch, "knn_top1": knn_top1})
                 # A mid-epoch preemption save records the PREVIOUS epoch
                 # as completed, so resume redoes this partial epoch from
                 # its start (same granularity the reference's per-epoch

@@ -44,6 +44,10 @@ class MocoConfig:
     # Streaming pallas InfoNCE (no (B, 1+K) logits materialization):
     # None = auto (on for TPU + replicated tile-divisible queue).
     fused_infonce: Optional[bool] = None
+    # Queue tile size streamed through VMEM per grid step; 0 = the
+    # kernel's DEFAULT_BLOCK_K. Small values let tests drive the real
+    # kernel (not the dense fallback) at toy K.
+    fused_block_k: int = 0
     # Rematerialize the query-encoder forward in the backward pass
     # (jax.checkpoint): trades ~30% more FLOPs for O(depth) less
     # activation HBM — for big models / big per-chip batches.
@@ -106,6 +110,13 @@ class TrainConfig:
     log_every: int = 10  # --print-freq
     checkpoint_every_epochs: int = 1
     steps_per_epoch: Optional[int] = None  # None = derive from dataset size
+    # Periodic weighted-kNN monitor on frozen backbone features (the
+    # cheap probe proxy the reference lacks — moco_tpu/knn.py): run every
+    # N epochs; 0 disables. Requires a labeled dataset (train=False split
+    # buildable from config.data, or knn_datasets passed to train()).
+    knn_every_epochs: int = 0
+    knn_k: int = 200
+    knn_temperature: float = 0.07
 
 
 def config_to_dict(cfg: TrainConfig) -> dict:

@@ -26,6 +26,10 @@ def _run_steps(fused: bool, n_steps: int = 2):
             cifar_stem=True,
             compute_dtype="float32",
             fused_infonce=fused,
+            # block_k=32 with K=64 → the REAL pallas kernel (interpret
+            # mode, 2-tile grid) runs inside the train step, not the
+            # dense fallback infonce_stats would take at K < block.
+            fused_block_k=32,
         ),
         optim=OptimConfig(lr=0.05, epochs=2, cos=True),
         data=DataConfig(dataset="synthetic", image_size=16, global_batch=8),
@@ -52,8 +56,7 @@ def _run_steps(fused: bool, n_steps: int = 2):
 
 def test_fused_step_matches_dense_step():
     # fused_infonce=True on CPU runs the pallas kernel in interpret mode
-    # (K=64 < block -> reference fallback inside the op; the kernel itself
-    # is covered by test_fused_infonce.py)
+    # over a 2-tile grid (fused_block_k=32, K=64)
     state_f, hist_f = _run_steps(fused=True)
     state_d, hist_d = _run_steps(fused=False)
     for mf, md in zip(hist_f, hist_d):

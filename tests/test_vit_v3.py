@@ -138,18 +138,34 @@ def test_momentum_cos_requires_total_steps():
     make_train_step(config, encoder, tx, mesh, predictor=predictor, total_steps=8)
 
 
-def test_v3_resnet_gets_v3_heads():
-    """v3 + ResNet must use the 3-layer BN-MLP projection head, not the
-    v2 head (hybrid-model regression guard)."""
-    cfg = MocoConfig(
+def test_v3_head_shapes_per_backbone_family():
+    """Upstream moco-v3 `_build_projector_and_predictor_mlps`: ResNet
+    gets a 2-layer projector + predictor WITHOUT the final BN; ViT gets
+    the 3-layer projector + predictor ending in affine-free BN."""
+    from moco_tpu.models import V3MLPHead
+
+    r_cfg = MocoConfig(
         arch="resnet18", dim=32, num_negatives=0, v3=True,
         shuffle="none", cifar_stem=True, compute_dtype="float32",
     )
-    enc = build_encoder(cfg, num_data=1)
-    from moco_tpu.models import V3MLPHead
+    r_enc = build_encoder(r_cfg, num_data=1)
+    r_pred = build_predictor(r_cfg, num_data=1)
+    assert isinstance(r_enc.head, V3MLPHead)
+    assert r_enc.head.num_layers == 2 and r_enc.head.last_bn
+    assert r_pred.num_layers == 2 and not r_pred.last_bn
+    # predictor without last_bn really has no BN after the output Dense
+    pv = r_pred.init(jax.random.PRNGKey(0), jnp.zeros((2, 32)), train=False)
+    n_bn = sum(1 for k in pv["params"] if k.startswith("BatchNorm"))
+    assert n_bn == 1  # only the hidden layer's BN
 
-    assert isinstance(enc.head, V3MLPHead)
-    assert enc.head.num_layers == 3
+    v_cfg = MocoConfig(
+        arch="vit_tiny", dim=32, num_negatives=0, v3=True,
+        shuffle="none", compute_dtype="float32", vit_patch_size=4,
+    )
+    v_enc = build_encoder(v_cfg, num_data=1)
+    v_pred = build_predictor(v_cfg, num_data=1)
+    assert v_enc.head.num_layers == 3 and v_enc.head.last_bn
+    assert v_pred.num_layers == 2 and v_pred.last_bn
 
 
 def test_v3_predictor_trains(v3_setup):
