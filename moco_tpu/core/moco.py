@@ -255,6 +255,18 @@ def make_train_step(
 
     fused_block_k = cfg.fused_block_k or DEFAULT_BLOCK_K
     use_fused = cfg.fused_infonce
+    if use_fused and (
+        fused_block_k <= 0
+        or cfg.num_negatives <= 0
+        or cfg.num_negatives % fused_block_k
+    ):
+        # infonce_stats would silently fall back to the dense path on a
+        # non-divisor block — an explicit fused request must not degrade
+        # to materializing the (B, 1+K) logits it exists to avoid.
+        raise ValueError(
+            f"fused_infonce=True needs a positive block that divides K: "
+            f"K={cfg.num_negatives}, block_k={fused_block_k}"
+        )
     if use_fused is None:
         use_fused = (
             jax.default_backend() == "tpu"

@@ -35,10 +35,10 @@ from moco_tpu.data.augment import (
     PROBE_RECIPE,
     apply_recipe,
     get_recipe,
-    normalize,
     two_crop_augment,
 )
 from moco_tpu.data.datasets import build_dataset
+from moco_tpu.parallel.dist import ProcessDataPartition
 from moco_tpu.parallel.mesh import DATA_AXIS
 from moco_tpu.utils.config import DataConfig
 
@@ -99,6 +99,21 @@ class _HostPipeline:
         self.steps_per_epoch = n // self.batch_size if drop_last else -(-n // self.batch_size)
         self._pool = ThreadPoolExecutor(max_workers=max(config.num_workers, 1))
         self._sharding = NamedSharding(mesh, P(DATA_AXIS))
+        # Multi-host input sharding (DistributedSampler equivalent,
+        # main_moco.py:~L258): this process decodes only the global-batch
+        # rows owned by its addressable devices; single-host it holds all
+        # rows, so one code path serves both.
+        self._partition = ProcessDataPartition(self._sharding, self.batch_size)
+
+    def _put_batch(self, global_indices: np.ndarray) -> tuple[jax.Array, jax.Array]:
+        """Decode this process's rows of the step's global batch and
+        assemble (images, labels) as globally-sharded jax.Arrays."""
+        local_idx = self._partition.local_indices(global_indices)
+        raw, labels = self._host_batch(local_idx)
+        return (
+            self._partition.assemble(raw),
+            self._partition.assemble(np.asarray(labels, np.int32)),
+        )
 
     def _host_batch(self, indices: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         """(images uint8 stack, labels int32) via the native C++ batch path
@@ -141,9 +156,8 @@ class TwoCropPipeline(_HostPipeline):
         def gen():
             for step in range(self.steps_per_epoch):
                 idx = order[step * self.batch_size : (step + 1) * self.batch_size]
-                raw, _ = self._host_batch(idx)
+                raw, _ = self._put_batch(idx)
                 step_rng = jax.random.fold_in(rng, step)
-                raw = jax.device_put(raw, self._sharding)
                 yield self._augment(step_rng, raw)
 
         return _prefetch(gen(), depth=2)
@@ -172,13 +186,9 @@ class LabeledPipeline(_HostPipeline):
         def gen():
             for step in range(self.steps_per_epoch):
                 idx = order[step * self.batch_size : (step + 1) * self.batch_size]
-                raw, labels = self._host_batch(idx)
+                raw, labels = self._put_batch(idx)
                 step_rng = jax.random.fold_in(rng, step)
-                raw = jax.device_put(raw, self._sharding)
-                yield (
-                    self._augment(step_rng, raw),
-                    jax.device_put(jnp.asarray(labels), self._sharding),
-                )
+                yield self._augment(step_rng, raw), labels
 
         return _prefetch(gen(), depth=2)
 
@@ -198,8 +208,23 @@ class EvalPipeline(_HostPipeline):
     def __iter__(self):
         recipe = get_recipe(self.config.aug_plus, self.config.image_size)
         n = len(self.dataset)
+        out_size = self.config.image_size
+
+        # uint8 crosses the host->device boundary (4x less transfer than
+        # fp32); /255, center-crop, normalize run jitted on the sharded
+        # array, like the train pipelines do
+        @jax.jit
+        def _prep(raw_uint8):
+            x = raw_uint8.astype(jnp.float32) / 255.0
+            if x.shape[1] != out_size:
+                y0 = (x.shape[1] - out_size) // 2
+                x = x[:, y0 : y0 + out_size, y0 : y0 + out_size]
+            mean = jnp.asarray(recipe.mean, jnp.float32)
+            std = jnp.asarray(recipe.std, jnp.float32)
+            return (x - mean) / std
 
         def gen():
+            part = self._partition
             for step in range(self.steps):
                 start = step * self.batch_size
                 idx = np.arange(start, min(start + self.batch_size, n))
@@ -207,16 +232,12 @@ class EvalPipeline(_HostPipeline):
                 if valid < self.batch_size:  # pad the tail, mask the pads
                     idx = np.concatenate([idx, np.full(self.batch_size - valid, idx[-1])])
                 mask = (np.arange(self.batch_size) < valid).astype(np.float32)
-                raw, labels = self._host_batch(idx)
-                x = jnp.asarray(raw, jnp.float32) / 255.0
-                if x.shape[1] != self.config.image_size:
-                    y0 = (x.shape[1] - self.config.image_size) // 2
-                    x = x[:, y0 : y0 + self.config.image_size, y0 : y0 + self.config.image_size]
-                x = normalize(x, recipe.mean, recipe.std)
+                # per-process decode of only this host's rows
+                raw, labels = self._host_batch(part.local_indices(idx))
                 yield (
-                    jax.device_put(x, self._sharding),
-                    jax.device_put(jnp.asarray(labels), self._sharding),
-                    jax.device_put(jnp.asarray(mask), self._sharding),
+                    _prep(part.assemble(raw)),
+                    part.assemble(np.asarray(labels, np.int32)),
+                    part.assemble(mask[part.local_positions]),
                 )
 
         return _prefetch(gen(), depth=2)

@@ -1,3 +1,8 @@
+from moco_tpu.parallel.dist import (
+    ProcessDataPartition,
+    device_row_ranges,
+    maybe_initialize_multihost,
+)
 from moco_tpu.parallel.mesh import (
     DATA_AXIS,
     MODEL_AXIS,
@@ -20,6 +25,9 @@ from moco_tpu.parallel.ring_attention import ring_attention
 __all__ = [
     "DATA_AXIS",
     "MODEL_AXIS",
+    "ProcessDataPartition",
+    "device_row_ranges",
+    "maybe_initialize_multihost",
     "batch_sharding",
     "create_mesh",
     "create_multislice_mesh",

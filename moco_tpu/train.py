@@ -25,7 +25,7 @@ import numpy as np
 
 from moco_tpu.core import build_encoder, build_predictor, create_state, make_train_step, place_state
 from moco_tpu.data.pipeline import TwoCropPipeline
-from moco_tpu.parallel import create_mesh, create_multislice_mesh
+from moco_tpu.parallel import create_mesh, create_multislice_mesh, maybe_initialize_multihost
 from moco_tpu.utils.checkpoint import CheckpointManager
 from moco_tpu.utils.config import TrainConfig, config_to_dict
 from moco_tpu.utils.metrics import AverageMeter, MetricWriter, ProgressMeter, profiler_trace
@@ -45,6 +45,10 @@ def train(
     (bank_dataset, test_dataset) pair for the periodic kNN monitor
     (config.knn_every_epochs); when None it is built from config.data.
     """
+    # Multi-host rendezvous before any backend use (the reference's
+    # dist.init_process_group; auto-detected from the coordinator env,
+    # or forced with MOCO_MULTIHOST=1).
+    maybe_initialize_multihost()
     if config.parallel.num_data is None:
         # slice-aware layout: on multi-slice deployments the data axis
         # orders ICI-adjacent chips together so grad psum rides ICI first
@@ -133,15 +137,21 @@ def train(
         )
 
     # num_classes once at setup: every in-repo dataset exposes it; for a
-    # foreign injected dataset scan ALL labels (a first-N scan would
+    # foreign injected dataset prefer a decode-free label source and only
+    # as a last resort scan ALL labels via load() (a first-N scan would
     # under-count on class-sorted layouts like ImageFolder and silently
     # zero out the one_hot votes for the missed classes).
     knn_num_classes = None
-    if knn_pair is not None:
+    if config.knn_every_epochs and knn_pair is not None:
         bank = knn_pair[0]
-        knn_num_classes = getattr(bank, "num_classes", None) or int(
-            np.max([bank.load(i)[1] for i in range(len(bank))]) + 1
-        )
+        knn_num_classes = getattr(bank, "num_classes", None)
+        if knn_num_classes is None:
+            labels = getattr(bank, "labels", None)
+            if labels is None and getattr(bank, "samples", None) is not None:
+                labels = [l for _, l in bank.samples]
+            if labels is None:
+                labels = [bank.load(i)[1] for i in range(len(bank))]
+            knn_num_classes = int(np.max(np.asarray(labels)) + 1)
 
     def run_knn(epoch: int) -> Optional[float]:
         if not (config.knn_every_epochs and knn_pair):
