@@ -69,7 +69,12 @@ def create_backbone(cfg: MocoConfig, num_data: Optional[int] = None) -> nn.Modul
         from moco_tpu.models.vit import create_vit
 
         vit_kw = {"patch_size": cfg.vit_patch_size} if cfg.vit_patch_size else {}
-        return create_vit(cfg.arch, dtype=dtype, **vit_kw)
+        return create_vit(
+            cfg.arch,
+            dtype=dtype,
+            use_flash_attention=cfg.vit_flash_attention,
+            **vit_kw,
+        )
     syncbn_axis = DATA_AXIS if cfg.shuffle == "syncbn" else None
     groups = None
     if syncbn_axis and cfg.syncbn_group_size and num_data is None:

@@ -14,9 +14,14 @@ list. TPU-first choices:
   sequence — everything XLA wants: one fused attention matmul chain on
   the MXU, no dynamic shapes.
 
-Attention uses plain `jnp.einsum` — at 197 tokens the whole sequence
-fits in VMEM and XLA's fusion is already optimal; a pallas flash kernel
-(moco_tpu/ops) only pays off at the long sequences ring attention serves.
+Attention defaults to plain `jnp.einsum` — at 197 tokens the whole
+sequence fits in VMEM and XLA's fusion is already optimal. Setting
+`use_flash_attention=True` swaps in the Pallas flash kernel
+(`moco_tpu/ops/flash_attention`, which pads + masks ViT's prime 197 to
+the block size) via flax's `attention_fn` hook — the parameter tree is
+identical either way, so checkpoints are interchangeable between the
+two paths. Worth it for the long-sequence regime (high-res/video
+tokens); at 197 it is a correctness-exercised alternative, not a win.
 """
 
 from __future__ import annotations
@@ -27,6 +32,19 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+def flash_attention_fn(query, key, value, **kwargs):
+    """`nn.MultiHeadDotProductAttention`-compatible attention_fn backed
+    by the Pallas flash kernel. Inputs arrive (B, S, H, Dh); the kernel
+    wants (B, H, S, Dh). Ignores bias/mask/dropout (ViT uses none)."""
+    from moco_tpu.ops.flash_attention import flash_attention
+
+    q = query.transpose(0, 2, 1, 3)
+    k = key.transpose(0, 2, 1, 3)
+    v = value.transpose(0, 2, 1, 3)
+    out = flash_attention(q, k, v, interpret=jax.default_backend() != "tpu")
+    return out.transpose(0, 2, 1, 3)
 
 
 def sincos_2d_posembed(dim: int, grid: int, cls_token: bool = True) -> np.ndarray:
@@ -64,12 +82,16 @@ class EncoderBlock(nn.Module):
     num_heads: int
     mlp_dim: int
     dtype: jnp.dtype = jnp.float32
+    use_flash_attention: bool = False
 
     @nn.compact
     def __call__(self, x):
         y = nn.LayerNorm(dtype=self.dtype)(x)
+        attn_kwargs = (
+            {"attention_fn": flash_attention_fn} if self.use_flash_attention else {}
+        )
         y = nn.MultiHeadDotProductAttention(
-            num_heads=self.num_heads, dtype=self.dtype, deterministic=True
+            num_heads=self.num_heads, dtype=self.dtype, deterministic=True, **attn_kwargs
         )(y, y)
         x = x + y
         y = nn.LayerNorm(dtype=self.dtype)(x)
@@ -89,6 +111,7 @@ class VisionTransformer(nn.Module):
     mlp_dim: int = 3072
     image_size: int = 224
     dtype: jnp.dtype = jnp.float32
+    use_flash_attention: bool = False
 
     @property
     def num_features(self) -> int:
@@ -121,7 +144,11 @@ class VisionTransformer(nn.Module):
         x = x + jnp.asarray(pos, self.dtype)
         for i in range(self.depth):
             x = EncoderBlock(
-                num_heads=self.num_heads, mlp_dim=self.mlp_dim, dtype=self.dtype, name=f"block_{i}"
+                num_heads=self.num_heads,
+                mlp_dim=self.mlp_dim,
+                dtype=self.dtype,
+                use_flash_attention=self.use_flash_attention,
+                name=f"block_{i}",
             )(x)
         x = nn.LayerNorm(dtype=self.dtype, name="final_norm")(x)
         return x[:, 0].astype(jnp.float32)  # cls token

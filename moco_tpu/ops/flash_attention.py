@@ -1,4 +1,4 @@
-"""Blockwise (flash) attention as a Pallas TPU kernel.
+"""Blockwise (flash) attention as Pallas TPU kernels, forward AND backward.
 
 Why it exists: the reference is a CNN codebase with no attention at all
 (SURVEY.md §5.7); this framework adds the ViT/MoCo-v3 family, and makes
@@ -10,10 +10,21 @@ score matrix blows past VMEM. The classic streaming-softmax recipe
 running max `m`, running denominator `l`, running numerator `acc`,
 renormalized as each key/value block arrives.
 
+Arbitrary sequence lengths are supported by padding to the block size
+and masking padded keys inside the kernel (ViT's 197 = 196 patches +
+cls is prime — without masking no block size divides it and the kernel
+would never engage).
+
+The backward pass is two Pallas kernels (dq; dk/dv), each recomputing
+attention probabilities from (q, k, lse) per tile — O(block²) live
+state, like the forward. A jnp chunked-recompute fallback remains for
+CPU/interpret use and as the grad oracle in tests.
+
 It is also the per-device compute block of ring attention
 (`moco_tpu/parallel/ring_attention.py`): `flash_attention_with_lse`
 returns the (out, logsumexp) pair that lets partial attention results
-from different devices be combined exactly.
+from different devices be combined exactly, and the backward carries
+the lse cotangent that merge induces.
 
 Non-causal (ViT is bidirectional); fp32 accumulation regardless of
 input dtype; jnp reference implementation included for testing and as
@@ -32,6 +43,9 @@ from jax.experimental import pallas as pl
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 NEG_INF = -1e30
+# Padded-row lse sentinel: exp(s - LSE_PAD) == 0 for any finite s, so
+# padded queries contribute nothing in the backward kernels.
+LSE_PAD = 1e30
 
 
 def _attn_reference(q, k, v, scale):
@@ -43,30 +57,58 @@ def _attn_reference(q, k, v, scale):
     return out.astype(q.dtype), lse
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, scale: float):
+def _pad_axis(x: jax.Array, axis: int, mult: int, value: float = 0.0) -> jax.Array:
+    size = x.shape[axis]
+    pad = (-size) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths, constant_values=value)
+
+
+# ------------------------------------------------------------- forward
+
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, scale: float, kv_len: int
+):
     """One (batch*head, q-block) program: stream all K/V blocks.
 
-    Refs: q (block_q, D); k, v (S, D) — whole K/V in VMEM per program
+    Refs: q (block_q, D); k, v (S_pad, D) — whole K/V in VMEM per program
     (ring attention keeps S_local small; for single-device long-S the
     grid could also block K, at the cost of a scratch accumulator).
+    Keys at column ≥ kv_len are padding and masked to -inf.
+
+    Dots run in the INPUT dtype with fp32 accumulation (MXU-native for
+    bf16 inputs; forcing fp32 operands was measured ~2x slower than the
+    XLA default-precision jnp fallback); softmax statistics stay fp32.
     """
-    q = q_ref[...].astype(jnp.float32) * scale
+    q = q_ref[...]
     seq_k, d = k_ref.shape
     block_q = q.shape[0]
+    masked = kv_len < seq_k
 
     def body(start, carry):
         acc, m_prev, l_prev = carry
-        kb = k_ref[pl.ds(start, block_k), :].astype(jnp.float32)
-        vb = v_ref[pl.ds(start, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # (block_q, block_k)
+        kb = k_ref[pl.ds(start, block_k), :]
+        vb = v_ref[pl.ds(start, block_k), :]
+        s = (
+            jax.lax.dot_general(
+                q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            * scale
+        )  # (block_q, block_k) fp32
+        if masked:  # static: only when padding exists
+            cols = start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(cols < kv_len, s, NEG_INF)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[:, None])
         correction = jnp.exp(m_prev - m_new)
         l_new = l_prev * correction + jnp.sum(p, axis=-1)
         acc = acc * correction[:, None] + jax.lax.dot_general(
-            p, vb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         return acc, m_new, l_new
 
@@ -79,7 +121,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, scale: f
         0, num_blocks, lambda i, c: body(i * block_k, c), (acc0, m0, l0)
     )
     o_ref[...] = (acc / l[:, None]).astype(o_ref.dtype)
-    lse_ref[...] = m + jnp.log(l)
+    # lse is carried as a (1, block_q) row vector: Mosaic requires 2-D
+    # blocks whose trailing dims are (8, 128)-aligned or full-array.
+    lse_ref[0, :] = m + jnp.log(l)
 
 
 def _flash_forward(
@@ -93,34 +137,246 @@ def _flash_forward(
 ) -> tuple[jax.Array, jax.Array]:
     b, h, s_q, d = q.shape
     s_k = k.shape[2]
-    if s_q % block_q or s_k % block_k:
-        # odd sizes (e.g. ViT's 197 tokens): fall back to the dense path
+    if s_k < block_k:
+        # short sequences: the dense path is already a single VMEM tile
         return _attn_reference(q, k, v, scale)
     bh = b * h
-    qr = q.reshape(bh, s_q, d)
-    kr = k.reshape(bh, s_k, d)
-    vr = v.reshape(bh, s_k, d)
+    qp = _pad_axis(q.reshape(bh, s_q, d), 1, block_q)
+    kp = _pad_axis(k.reshape(bh, s_k, d), 1, block_k)
+    vp = _pad_axis(v.reshape(bh, s_k, d), 1, block_k)
+    sq_p, sk_p = qp.shape[1], kp.shape[1]
 
-    kernel = functools.partial(_flash_kernel, block_k=block_k, scale=scale)
+    kernel = functools.partial(_flash_kernel, block_k=block_k, scale=scale, kv_len=s_k)
     out, lse = pl.pallas_call(
         kernel,
-        grid=(bh, s_q // block_q),
+        grid=(bh, sq_p // block_q),
         in_specs=[
             pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),  # None: squeeze bh
-            pl.BlockSpec((None, s_k, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, s_k, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, sk_p, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, sk_p, d), lambda i, j: (i, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, block_q), lambda i, j: (i, j)),
+            pl.BlockSpec((None, 1, block_q), lambda i, j: (i, 0, j)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s_q, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, s_q), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq_p, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, sq_p), jnp.float32),
         ],
         interpret=interpret,
-    )(qr, kr, vr)
-    return out.reshape(b, h, s_q, d), lse.reshape(b, h, s_q)
+    )(qp, kp, vp)
+    return (
+        out[:, :s_q].reshape(b, h, s_q, d),
+        lse[:, 0, :s_q].reshape(b, h, s_q),
+    )
+
+
+# ------------------------------------------------------------ backward
+
+
+def _dq_kernel(
+    q_ref, g_ref, lse_ref, delta_ref, glse_ref, k_ref, v_ref, dq_ref,
+    *, block_k: int, scale: float, kv_len: int,
+):
+    """One (batch*head, q-block) program: dq for this query block,
+    streaming K/V. ds = p ⊙ (g·vᵀ − Δ + g_lse); dq = ds·k·scale.
+    Per-row stats arrive as (1, block_q) row vectors (Mosaic 2-D rule).
+
+    NB a single fused dq+dk+dv kernel (score matrix computed once per
+    tile, dq accumulated across the minor grid dim) was tried and wedged
+    the remote-TPU session at compile/run; the two-pass split below is
+    Mosaic-proven. Dots run in the INPUT dtype with fp32 accumulation
+    (bf16 MXU passes; forcing fp32 operands measured ~2x slower)."""
+    q = q_ref[...]
+    g = g_ref[...]
+    lse = lse_ref[0, :]
+    coeff = glse_ref[0, :] - delta_ref[0, :]  # (block_q,)
+    seq_k, d = k_ref.shape
+    block_q = q.shape[0]
+    masked = kv_len < seq_k
+
+    def body(start, acc):
+        kb = k_ref[pl.ds(start, block_k), :]
+        vb = v_ref[pl.ds(start, block_k), :]
+        s = (
+            jax.lax.dot_general(
+                q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            * scale
+        )
+        if masked:
+            cols = start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(cols < kv_len, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(
+            g, vb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = (p * (dp + coeff[:, None])).astype(kb.dtype)
+        return acc + jax.lax.dot_general(
+            ds, kb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    num_blocks = seq_k // block_k
+    acc = jax.lax.fori_loop(0, num_blocks, lambda i, a: body(i * block_k, a), acc0)
+    dq_ref[...] = (acc * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    k_ref, v_ref, q_ref, g_ref, lse_ref, delta_ref, glse_ref, dk_ref, dv_ref,
+    *, block_q: int, scale: float,
+):
+    """One (batch*head, k-block) program: dk, dv for this key block,
+    streaming Q/G. Padded query rows carry lse = LSE_PAD ⇒ p = 0, so
+    they contribute nothing; padded key rows are sliced off outside."""
+    kb = k_ref[...]
+    vb = v_ref[...]
+    seq_q, d = q_ref.shape
+    block_k = kb.shape[0]
+
+    def body(start, carry):
+        dk_acc, dv_acc = carry
+        qb = q_ref[pl.ds(start, block_q), :]
+        gb = g_ref[pl.ds(start, block_q), :]
+        lse_b = lse_ref[0, pl.ds(start, block_q)]
+        coeff_b = glse_ref[0, pl.ds(start, block_q)] - delta_ref[0, pl.ds(start, block_q)]
+        s = (
+            jax.lax.dot_general(
+                qb, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            * scale
+        )  # (block_q, block_k)
+        p = jnp.exp(s - lse_b[:, None])
+        dv_acc = dv_acc + jax.lax.dot_general(
+            p.astype(gb.dtype), gb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            gb, vb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = (p * (dp + coeff_b[:, None])).astype(qb.dtype)
+        dk_acc = dk_acc + jax.lax.dot_general(
+            ds, qb, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return dk_acc, dv_acc
+
+    zeros = jnp.zeros((block_k, d), jnp.float32)
+    num_blocks = seq_q // block_q
+    dk, dv = jax.lax.fori_loop(
+        0, num_blocks, lambda i, c: body(i * block_q, c), (zeros, zeros)
+    )
+    dk_ref[...] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
+
+
+def _flash_backward_pallas(
+    q, k, v, out, lse, g, g_lse, scale, block_q, block_k, interpret
+):
+    b, h, s_q, d = q.shape
+    s_k = k.shape[2]
+    bh = b * h
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+
+    qp = _pad_axis(q.reshape(bh, s_q, d), 1, block_q)
+    gp = _pad_axis(g.reshape(bh, s_q, d), 1, block_q)
+    # per-row stats as (bh, 1, Sq) row vectors — Mosaic needs 2-D blocks
+    lsep = _pad_axis(lse.reshape(bh, 1, s_q), 2, block_q, value=LSE_PAD)
+    deltap = _pad_axis(delta.reshape(bh, 1, s_q), 2, block_q)
+    glsep = _pad_axis(g_lse.reshape(bh, 1, s_q), 2, block_q)
+    kp = _pad_axis(k.reshape(bh, s_k, d), 1, block_k)
+    vp = _pad_axis(v.reshape(bh, s_k, d), 1, block_k)
+    sq_p, sk_p = qp.shape[1], kp.shape[1]
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, block_k=block_k, scale=scale, kv_len=s_k),
+        grid=(bh, sq_p // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, 1, block_q), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((None, 1, block_q), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((None, 1, block_q), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((None, sk_p, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, sk_p, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq_p, d), q.dtype),
+        interpret=interpret,
+    )(qp, gp, lsep, deltap, glsep, kp, vp)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, block_q=block_q, scale=scale),
+        grid=(bh, sk_p // block_k),
+        in_specs=[
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, sq_p, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, sq_p, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, 1, sq_p), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, 1, sq_p), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, 1, sq_p), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk_p, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk_p, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(kp, vp, qp, gp, lsep, deltap, glsep)
+
+    return (
+        dq[:, :s_q].reshape(b, h, s_q, d),
+        dk[:, :s_k].reshape(b, h, s_k, d),
+        dv[:, :s_k].reshape(b, h, s_k, d),
+    )
+
+
+def _flash_backward_jnp(q, k, v, out, lse, g, g_lse, scale, block_q):
+    """Recompute-based backward, CHUNKED over query blocks: attention
+    probabilities are rebuilt from q, k and the saved lse per (block_q,
+    S_k) tile inside a sequential `lax.map`, so peak memory is
+    O(block_q·S_k) — never the full (S_q, S_k) matrix the forward kernel
+    exists to avoid. dk/dv accumulate across chunks; dq is per-chunk.
+    Serves as the CPU fallback and the grad oracle for the Pallas bwd."""
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    outf = out.astype(jnp.float32)
+    s_q = q.shape[2]
+
+    def chunk_grads(args):
+        qc, gc, outc, lsec, glsec = args  # (B,H,bq,D) / (B,H,bq)
+        logits = jnp.einsum("bhqd,bhkd->bhqk", qc, kf) * scale
+        p = jnp.exp(logits - lsec[..., None])  # (B,H,bq,Sk)
+        dv_c = jnp.einsum("bhqk,bhqd->bhkd", p, gc)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", gc, vf)
+        delta = jnp.sum(gc * outc, axis=-1, keepdims=True)
+        # d(lse)/dq flows through p too
+        ds = p * (dp - delta + glsec[..., None])
+        dq_c = jnp.einsum("bhqk,bhkd->bhqd", ds, kf) * scale
+        dk_c = jnp.einsum("bhqk,bhqd->bhkd", ds, qc) * scale
+        return dq_c, dk_c, dv_c
+
+    if s_q % block_q or s_q == block_q:  # single chunk / odd sizes: one shot
+        dq, dk, dv = chunk_grads((qf, gf, outf, lse, g_lse))
+    else:
+        n_chunks = s_q // block_q
+
+        def to_chunks(x):  # (B,H,Sq,...) -> (n, B,H,bq,...)
+            return jnp.stack(jnp.split(x, n_chunks, axis=2))
+
+        dq_c, dk_c, dv_c = jax.lax.map(
+            chunk_grads,
+            (to_chunks(qf), to_chunks(gf), to_chunks(outf), to_chunks(lse), to_chunks(g_lse)),
+        )
+        dq = jnp.concatenate(list(dq_c), axis=2)
+        dk = jnp.sum(dk_c, axis=0)
+        dv = jnp.sum(dv_c, axis=0)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -149,53 +405,23 @@ def _fwd(q, k, v, scale, block_q, block_k, interpret):
 
 
 def _bwd(scale, block_q, block_k, interpret, res, cotangents):
-    """Recompute-based backward, CHUNKED over query blocks: attention
-    probabilities are rebuilt from q, k and the saved lse per (block_q,
-    S_k) tile inside a sequential `lax.map`, so peak memory is
-    O(block_q·S_k) — never the full (S_q, S_k) matrix the forward kernel
-    exists to avoid. dk/dv accumulate across chunks; dq is per-chunk."""
     q, k, v, out, lse = res
     g, g_lse = cotangents
     scale_ = scale if scale is not None else q.shape[-1] ** -0.5
-    qf = q.astype(jnp.float32)
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
-    gf = g.astype(jnp.float32)
-    outf = out.astype(jnp.float32)
     g_lse_f = (
         jnp.zeros(lse.shape, jnp.float32) if g_lse is None else g_lse.astype(jnp.float32)
     )
-    s_q = q.shape[2]
-
-    def chunk_grads(args):
-        qc, gc, outc, lsec, glsec = args  # (B,H,bq,D) / (B,H,bq)
-        logits = jnp.einsum("bhqd,bhkd->bhqk", qc, kf) * scale_
-        p = jnp.exp(logits - lsec[..., None])  # (B,H,bq,Sk)
-        dv_c = jnp.einsum("bhqk,bhqd->bhkd", p, gc)
-        dp = jnp.einsum("bhqd,bhkd->bhqk", gc, vf)
-        delta = jnp.sum(gc * outc, axis=-1, keepdims=True)
-        # d(lse)/dq flows through p too
-        ds = p * (dp - delta + glsec[..., None])
-        dq_c = jnp.einsum("bhqk,bhkd->bhqd", ds, kf) * scale_
-        dk_c = jnp.einsum("bhqk,bhqd->bhkd", ds, qc) * scale_
-        return dq_c, dk_c, dv_c
-
-    if s_q % block_q or s_q == block_q:  # single chunk / odd sizes: one shot
-        dq, dk, dv = chunk_grads((qf, gf, outf, lse, g_lse_f))
-    else:
-        n_chunks = s_q // block_q
-
-        def to_chunks(x):  # (B,H,Sq,...) -> (n, B,H,bq,...)
-            return jnp.stack(jnp.split(x, n_chunks, axis=2))
-
-        dq_c, dk_c, dv_c = jax.lax.map(
-            chunk_grads,
-            (to_chunks(qf), to_chunks(gf), to_chunks(outf), to_chunks(lse), to_chunks(g_lse_f)),
+    # Pallas bwd engages exactly when the fwd kernel did (else the fwd
+    # saved lse came from the dense path and shapes are small anyway).
+    if k.shape[2] >= block_k:
+        dq, dk, dv = _flash_backward_pallas(
+            q, k, v, out, lse, g, g_lse_f, scale_, block_q, block_k, interpret
         )
-        dq = jnp.concatenate(list(dq_c), axis=2)
-        dk = jnp.sum(dk_c, axis=0)
-        dv = jnp.sum(dv_c, axis=0)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    else:
+        dq, dk, dv = _flash_backward_jnp(
+            q, k, v, out, lse, g, g_lse_f, scale_, block_q
+        )
+    return dq, dk, dv
 
 
 flash_attention_with_lse.defvjp(_fwd, _bwd)

@@ -41,6 +41,10 @@ class MocoConfig:
     # Override the ViT patch size (None = the arch's default, 16);
     # small-image tests/smoke configs use 4.
     vit_patch_size: Optional[int] = None
+    # ViT attention via the Pallas flash kernel (moco_tpu/ops); the
+    # parameter tree is identical to the dense path, so checkpoints are
+    # interchangeable. Pays off at long sequences (high-res/video).
+    vit_flash_attention: bool = False
     # Streaming pallas InfoNCE (no (B, 1+K) logits materialization):
     # None = auto (on for TPU + replicated tile-divisible queue).
     fused_infonce: Optional[bool] = None
@@ -77,6 +81,12 @@ class DataConfig:
     aug_plus: bool = False  # v2 aug recipe (jitter+blur), main_moco.py:~L225-255
     num_workers: int = 4
     on_device_augment: bool = True
+    # Sample RandomResizedCrop boxes on the HOST against the ORIGINAL
+    # image geometry and decode-once/crop-N in the loader (torchvision-
+    # exact crop distribution + 224² instead of 256² over PCIe). Applies
+    # to datasets exposing the host-crop protocol (imagefolder); others
+    # keep the on-device crop from the decode canvas.
+    host_rrc: bool = True
 
 
 @dataclasses.dataclass(frozen=True)

@@ -12,12 +12,20 @@ config flag.
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+# MOCO_TPU_TESTS=1 leaves the real accelerator visible so the TPU-gated
+# kernel tests (tests/test_tpu_kernels.py) can drive compiled Mosaic
+# kernels: `MOCO_TPU_TESTS=1 pytest tests/test_tpu_kernels.py`. Default
+# runs pin the 8-virtual-device CPU platform.
+if not os.environ.get("MOCO_TPU_TESTS"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
+if not os.environ.get("MOCO_TPU_TESTS"):
+    jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)

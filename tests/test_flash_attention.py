@@ -28,12 +28,40 @@ def test_forward_matches_dense(qkv):
     np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse), rtol=2e-5, atol=2e-5)
 
 
-def test_odd_seq_falls_back(qkv):
-    """ViT's 197 tokens are not a multiple of the block — dense fallback."""
+def test_odd_seq_runs_padded_kernel(qkv):
+    """ViT's 197 tokens (prime — no block divides them): the kernel pads
+    to the block size and masks padded keys; results must still be exact."""
     q, k, v = (x[:, :, :197] for x in qkv)
     out, lse = flash_attention_with_lse(q, k, v, interpret=True)
     ref_out, ref_lse = _attn_reference(q, k, v, D**-0.5)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out), rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse), rtol=2e-5, atol=2e-5)
+
+
+def test_odd_seq_gradients_match_dense(qkv):
+    """Padded-kernel backward: padded keys/queries must contribute zero."""
+    q, k, v = (x[:, :, :197] for x in qkv)
+
+    def flash_loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, interpret=True) ** 2)
+
+    def dense_loss(q, k, v):
+        return jnp.sum(_attn_reference(q, k, v, D**-0.5)[0] ** 2)
+
+    g_flash = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    for gf, gd in zip(g_flash, g_dense):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gd), rtol=1e-3, atol=1e-4)
+
+
+def test_short_seq_dense_path(qkv):
+    """S below one key block: the dense path serves it (value + grads)."""
+    q, k, v = (x[:, :, :48] for x in qkv)
+    out, lse = flash_attention_with_lse(q, k, v, interpret=True)
+    ref_out, ref_lse = _attn_reference(q, k, v, D**-0.5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out), rtol=2e-5, atol=2e-5)
+    g = jax.grad(lambda q: jnp.sum(flash_attention(q, k, v, interpret=True) ** 2))(q)
+    assert np.isfinite(np.asarray(g)).all()
 
 
 def test_gradients_match_dense(qkv):

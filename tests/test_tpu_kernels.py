@@ -1,0 +1,124 @@
+"""Compiled-Mosaic kernel tests on a REAL TPU (VERDICT r1 item 6).
+
+The rest of the suite runs Pallas kernels in interpret mode on the CPU
+mesh; Mosaic-vs-interpret divergence (block shape constraints, layout
+rules) only surfaces on hardware. Run with:
+
+    MOCO_TPU_TESTS=1 python -m pytest tests/test_tpu_kernels.py -q
+
+Skipped automatically when no TPU backend is visible (i.e. in the
+default CPU-pinned suite).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    jax.default_backend() != "tpu", reason="needs a real TPU backend"
+)
+
+
+def _rand(shape, key, dtype=jnp.float32):
+    return jax.random.normal(jax.random.PRNGKey(key), shape, dtype)
+
+
+class TestFusedInfoNCE:
+    B, C, K = 64, 128, 8192
+    BLOCK = 2048
+
+    def test_stats_match_dense_oracle(self):
+        from moco_tpu.ops.fused_infonce import _reference, infonce_stats
+
+        q = _rand((self.B, self.C), 0)
+        k = _rand((self.B, self.C), 1)
+        queue = _rand((self.K, self.C), 2)
+        q = q / jnp.linalg.norm(q, axis=-1, keepdims=True)
+        k = k / jnp.linalg.norm(k, axis=-1, keepdims=True)
+        queue = queue / jnp.linalg.norm(queue, axis=-1, keepdims=True)
+
+        pos, lse, above = jax.jit(
+            lambda q, k, qu: infonce_stats(q, k, qu, 0.2, self.BLOCK, False)
+        )(q, k, queue)
+        rpos, rlse, rabove = _reference(q, k, queue, 0.2)
+        np.testing.assert_allclose(np.asarray(pos), np.asarray(rpos), rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(lse), np.asarray(rlse), rtol=1e-4, atol=1e-4)
+        np.testing.assert_array_equal(np.asarray(above), np.asarray(rabove))
+
+    def test_loss_grads_match_dense(self):
+        from moco_tpu.ops.fused_infonce import fused_infonce_loss
+        from moco_tpu.ops.losses import cross_entropy, infonce_logits
+
+        q = _rand((self.B, self.C), 3)
+        k = _rand((self.B, self.C), 4)
+        queue = _rand((self.K, self.C), 5)
+        k = k / jnp.linalg.norm(k, axis=-1, keepdims=True)
+        queue = queue / jnp.linalg.norm(queue, axis=-1, keepdims=True)
+
+        def fused(q):
+            qn = q / jnp.linalg.norm(q, axis=-1, keepdims=True)
+            loss, _ = fused_infonce_loss(qn, k, queue, 0.2, self.BLOCK, False)
+            return loss
+
+        def dense(q):
+            qn = q / jnp.linalg.norm(q, axis=-1, keepdims=True)
+            logits, labels = infonce_logits(qn, k, queue, 0.2)
+            return cross_entropy(logits, labels)
+
+        lf, gf = jax.jit(jax.value_and_grad(fused))(q)
+        ld, gd = jax.jit(jax.value_and_grad(dense))(q)
+        np.testing.assert_allclose(float(lf), float(ld), rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gd), rtol=1e-3, atol=1e-5)
+
+
+class TestFlashAttention:
+    B, H, D = 2, 4, 64
+
+    @pytest.mark.parametrize("seq", [256, 197], ids=["block-divisible", "padded"])
+    def test_forward_matches_dense(self, seq):
+        from moco_tpu.ops.flash_attention import _attn_reference, flash_attention_with_lse
+
+        q, k, v = (_rand((self.B, self.H, seq, self.D), i) for i in range(3))
+        out, lse = jax.jit(
+            lambda q, k, v: flash_attention_with_lse(q, k, v, None, 128, 128, False)
+        )(q, k, v)
+        ref_out, ref_lse = _attn_reference(q, k, v, self.D**-0.5)
+        # TPU fp32 dots run as bf16 passes by default; flash and dense
+        # also sum in different orders — tolerances sized accordingly
+        # (exactness is enforced by the interpret-mode CPU tests).
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out), rtol=2e-2, atol=5e-3)
+        np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse), rtol=2e-2, atol=5e-3)
+
+    @pytest.mark.parametrize("seq", [256, 197], ids=["block-divisible", "padded"])
+    def test_grads_match_dense(self, seq):
+        from moco_tpu.ops.flash_attention import _attn_reference, flash_attention
+
+        q, k, v = (_rand((self.B, self.H, seq, self.D), 10 + i) for i in range(3))
+
+        def flash_loss(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, None, 128, 128, False) ** 2)
+
+        def dense_loss(q, k, v):
+            return jnp.sum(_attn_reference(q, k, v, self.D**-0.5)[0] ** 2)
+
+        g_flash = jax.jit(jax.grad(flash_loss, argnums=(0, 1, 2)))(q, k, v)
+        g_dense = jax.jit(jax.grad(dense_loss, argnums=(0, 1, 2)))(q, k, v)
+        for gf, gd in zip(g_flash, g_dense):
+            np.testing.assert_allclose(np.asarray(gf), np.asarray(gd), rtol=2e-2, atol=2e-2)
+
+    def test_vit_forward_with_flash(self):
+        """The wired consumer: a ViT forward on TPU using the kernel."""
+        from moco_tpu.models import create_vit
+
+        # patch 4 on 64px -> 257 tokens: above one block, exercises the
+        # padded kernel (not the short-seq dense fallback)
+        vit = create_vit("vit_tiny", image_size=64, patch_size=4, use_flash_attention=True)
+        vit_dense = create_vit("vit_tiny", image_size=64, patch_size=4)
+        x = _rand((2, 64, 64, 3), 20)
+        params = jax.jit(vit.init)(jax.random.PRNGKey(0), x)
+        out_flash = jax.jit(vit.apply)(params, x)
+        out_dense = jax.jit(vit_dense.apply)(params, x)
+        np.testing.assert_allclose(
+            np.asarray(out_flash), np.asarray(out_dense), rtol=2e-2, atol=2e-2
+        )
