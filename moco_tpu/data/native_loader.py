@@ -32,7 +32,7 @@ _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(__fil
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libmoco_loader.so")
 _build_lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
-ABI_VERSION = 2
+ABI_VERSION = 3
 
 
 def _build_locked() -> None:
@@ -56,6 +56,47 @@ def _build_locked() -> None:
             fcntl.flock(lockf, fcntl.LOCK_UN)
 
 
+def _declare_bindings(lib: ctypes.CDLL) -> None:
+    """Symbol declarations for the CURRENT ABI — only called after the
+    version check passes (a stale .so may lack the newer symbols, and a
+    failed dlsym here would otherwise mask the rebuild path)."""
+    lib.mtl_create.restype = ctypes.c_void_p
+    lib.mtl_create.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p),
+        ctypes.c_int64,
+        ctypes.c_int,
+        ctypes.c_int,
+    ]
+    lib.mtl_load_batch.restype = ctypes.c_int
+    lib.mtl_load_batch.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_uint8),
+    ]
+    lib.mtl_load_batch_crops.restype = ctypes.c_int
+    lib.mtl_load_batch_crops.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_uint8),
+    ]
+    lib.mtl_get_dims.restype = ctypes.c_int
+    lib.mtl_get_dims.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_uint8),
+    ]
+    lib.mtl_destroy.argtypes = [ctypes.c_void_p]
+
+
 def _load_lib() -> ctypes.CDLL:
     global _lib
     if _lib is not None:
@@ -66,30 +107,18 @@ def _load_lib() -> ctypes.CDLL:
         if not os.path.exists(_LIB_PATH):
             _build_locked()
         lib = ctypes.CDLL(_LIB_PATH)
-        lib.mtl_create.restype = ctypes.c_void_p
-        lib.mtl_create.argtypes = [
-            ctypes.POINTER(ctypes.c_char_p),
-            ctypes.c_int64,
-            ctypes.c_int,
-            ctypes.c_int,
-        ]
-        lib.mtl_load_batch.restype = ctypes.c_int
-        lib.mtl_load_batch.argtypes = [
-            ctypes.c_void_p,
-            ctypes.POINTER(ctypes.c_int64),
-            ctypes.c_int,
-            ctypes.POINTER(ctypes.c_uint8),
-            ctypes.POINTER(ctypes.c_uint8),
-        ]
-        lib.mtl_destroy.argtypes = [ctypes.c_void_p]
+        # version check BEFORE declaring ABI-current symbols: a stale .so
+        # lacks them and the dlsym failure would shadow this rebuild path
         lib.mtl_version.restype = ctypes.c_int
         if lib.mtl_version() != ABI_VERSION:
             # stale .so from an older checkout: rebuild once
             os.remove(_LIB_PATH)
             _build_locked()
             lib = ctypes.CDLL(_LIB_PATH)
+            lib.mtl_version.restype = ctypes.c_int
             if lib.mtl_version() != ABI_VERSION:
                 raise RuntimeError("native loader ABI mismatch after rebuild")
+        _declare_bindings(lib)
         _lib = lib
         return lib
 
@@ -167,6 +196,89 @@ class NativeBatchLoader:
                 )
         return out
 
+    def get_dims(self, indices: np.ndarray) -> np.ndarray:
+        """(bs, 2) original (h, w) per sample — header parse only, cached
+        in C++. Slots that fail get (0, 0); callers treat those as
+        undecodable (their crops degrade to the PIL fallback)."""
+        idx = np.ascontiguousarray(indices, dtype=np.int64)
+        dims = np.empty((len(idx), 2), np.int32)
+        status = np.empty(len(idx), np.uint8)
+        self._lib.mtl_get_dims(
+            self._handle,
+            idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(idx),
+            dims.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            status.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        )
+        return dims
+
+    def _pil_fallback_crops(
+        self, path: str, boxes: np.ndarray, out_size: int
+    ) -> Optional[np.ndarray]:
+        """(n_crops, out, out, 3) via PIL resized-crop — same geometry."""
+        try:
+            from PIL import Image
+
+            with Image.open(path) as im:
+                im = im.convert("RGB")
+                w, h = im.size
+                outs = []
+                for y0, x0, ch, cw in np.asarray(boxes, np.int64):
+                    y0 = int(np.clip(y0, 0, h - 1))
+                    x0 = int(np.clip(x0, 0, w - 1))
+                    ch = int(np.clip(ch, 1, h - y0))
+                    cw = int(np.clip(cw, 1, w - x0))
+                    crop = im.crop((x0, y0, x0 + cw, y0 + ch)).resize(
+                        (out_size, out_size), resample=Image.BILINEAR
+                    )
+                    outs.append(np.asarray(crop, np.uint8))
+                return np.stack(outs)
+        except Exception:
+            return None
+
+    def load_crops(
+        self, indices: np.ndarray, boxes: np.ndarray, out_size: int
+    ) -> np.ndarray:
+        """(bs, n_crops, out, out, 3) uint8: decode each sample ONCE, then
+        antialias-resize each of its boxes (y0, x0, ch, cw in original
+        coords). Failed slots retry through PIL; doubly-failed stay zero."""
+        idx = np.ascontiguousarray(indices, dtype=np.int64)
+        boxes = np.ascontiguousarray(boxes, dtype=np.int32)
+        bs, n_crops = boxes.shape[0], boxes.shape[1]
+        assert bs == len(idx) and boxes.shape[2] == 4
+        out = np.empty((bs, n_crops, out_size, out_size, 3), np.uint8)
+        status = np.empty(bs, np.uint8)
+        errors = self._lib.mtl_load_batch_crops(
+            self._handle,
+            idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            bs,
+            boxes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            n_crops,
+            out_size,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            status.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        )
+        if errors:
+            hard_failures = 0
+            for slot in np.nonzero(status == 0)[0]:
+                i = int(idx[slot])
+                img = (
+                    self._pil_fallback_crops(self.paths[i], boxes[slot], out_size)
+                    if 0 <= i < self.num_paths
+                    else None
+                )
+                if img is not None:
+                    out[slot] = img
+                else:
+                    hard_failures += 1
+            if hard_failures:
+                import warnings
+
+                warnings.warn(
+                    f"native loader: {hard_failures}/{bs} images failed to decode"
+                )
+        return out
+
     def __del__(self):
         handle = getattr(self, "_handle", None)
         if handle:
@@ -206,3 +318,16 @@ class NativeImageFolderDataset:
 
     def load_batch(self, indices: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         return self._loader.load_batch(indices), self._labels[np.asarray(indices)]
+
+    # -- host-crop protocol (pipeline samples torchvision-exact RRC boxes
+    # against original geometry; decode once, crop N times) --------------
+    def dims(self, indices: np.ndarray) -> np.ndarray:
+        return self._loader.get_dims(indices)
+
+    def load_crop_batch(
+        self, indices: np.ndarray, boxes: np.ndarray, out_size: int, pool=None
+    ) -> tuple[np.ndarray, np.ndarray]:
+        # `pool` accepted for PIL-path signature compatibility; the C++
+        # loader owns its own thread pool.
+        crops = self._loader.load_crops(indices, boxes, out_size)
+        return crops, self._labels[np.asarray(indices)]

@@ -4,11 +4,15 @@ Replaces the reference's `DataLoader(workers=32)` + `TwoCropsTransform`
 (`main_moco.py:~L255-260`, `moco/loader.py`). Split of labor:
 
 - host: index shuffling (per-epoch, seeded — the
-  `DistributedSampler.set_epoch` equivalent), image decode to a fixed
-  uint8 canvas (native C++ pool when built, else PIL threads), batch
-  stacking;
-- device: ALL stochastic augmentation, batched and jitted
-  (`moco_tpu.data.augment`), already sharded over the mesh's data axis;
+  `DistributedSampler.set_epoch` equivalent); for datasets exposing the
+  host-crop protocol (ImageFolder), torchvision-exact RandomResizedCrop
+  boxes sampled against each image's ORIGINAL geometry and executed in
+  the loader (decode once, crop/resize N times — native C++ pool when
+  built, else PIL threads); otherwise decode to a fixed uint8 canvas;
+- device: the remaining stochastic augmentation (jitter/gray/blur/flip/
+  normalize — plus the crop itself on the canvas path), batched and
+  jitted (`moco_tpu.data.augment`), already sharded over the mesh's
+  data axis;
 - a depth-2 prefetch queue overlaps host decode with the train step.
 
 Training pipelines use drop_last=True semantics (reference DataLoader) —
@@ -134,6 +138,46 @@ class _HostPipeline:
     def _epoch_rng(self, epoch: int) -> jax.Array:
         return jax.random.fold_in(jax.random.PRNGKey(self.seed), epoch)
 
+    @property
+    def host_crops(self) -> bool:
+        """Host-side RandomResizedCrop (decode-once/crop-N against the
+        ORIGINAL image geometry — torchvision-exact distribution, no
+        fixed-canvas clipping) when the dataset and config support it."""
+        return self.config.host_rrc and hasattr(self.dataset, "load_crop_batch")
+
+    def _put_crop_batch(
+        self, global_indices: np.ndarray, epoch: int, step: int,
+        n_crops: int, scale: tuple, out_size: int,
+    ) -> tuple[jax.Array, jax.Array]:
+        """Host-crop path: sample n_crops RRC boxes per image against its
+        original dims, decode once + crop/resize in the loader, assemble
+        globally sharded (B, n_crops, S, S, 3) uint8 + labels.
+
+        Box seeds are keyed by (seed, epoch, step, DATASET INDEX, crop) —
+        process-independent, so model-axis replica groups that span
+        processes (which hold the SAME global rows) decode identical
+        pixels. A per-process stream here would silently hand different
+        crops to different replicas of the same row."""
+        local_idx = self._partition.local_indices(global_indices)
+        dims = self.dataset.dims(local_idx)
+        from moco_tpu.data.datasets import sample_rrc_boxes
+
+        boxes = np.empty((len(local_idx), n_crops, 4), np.int32)
+        for row, ds_idx in enumerate(np.asarray(local_idx, np.int64)):
+            for c in range(n_crops):
+                rng = np.random.default_rng(
+                    (self.seed, epoch, step, int(ds_idx), c)
+                )
+                boxes[row, c] = sample_rrc_boxes(rng, dims[row : row + 1], scale=scale)[0]
+        raw, labels = self.dataset.load_crop_batch(
+            local_idx, boxes, out_size, pool=self._pool
+        )
+        # assemble per crop on the HOST side: slicing the crop axis of an
+        # already-assembled global array would not be fully-addressable
+        # under multi-host
+        views = [self._partition.assemble(np.ascontiguousarray(raw[:, c])) for c in range(n_crops)]
+        return views, self._partition.assemble(np.asarray(labels, np.int32))
+
 
 class TwoCropPipeline(_HostPipeline):
     """Iterable over {'im_q','im_k'} device batches for one epoch at a time."""
@@ -150,15 +194,36 @@ class TwoCropPipeline(_HostPipeline):
 
         self._augment = _augment
 
+        # host-crop variant: images arrive already cropped to out_size;
+        # the device applies everything in the recipe EXCEPT the crop
+        nocrop = recipe._replace(crop=False)
+
+        @jax.jit
+        def _augment_precropped(rng, q_uint8, k_uint8):
+            k_q, k_k = jax.random.split(rng)
+            q = apply_recipe(nocrop, k_q, q_uint8.astype(jnp.float32) / 255.0, out_size)
+            k = apply_recipe(nocrop, k_k, k_uint8.astype(jnp.float32) / 255.0, out_size)
+            return {"im_q": q, "im_k": k}
+
+        self._augment_precropped = _augment_precropped
+
     def epoch(self, epoch: int) -> Iterator[dict]:
         order, rng = self._epoch_order(epoch), self._epoch_rng(epoch)
 
         def gen():
             for step in range(self.steps_per_epoch):
                 idx = order[step * self.batch_size : (step + 1) * self.batch_size]
-                raw, _ = self._put_batch(idx)
                 step_rng = jax.random.fold_in(rng, step)
-                yield self._augment(step_rng, raw)
+                if self.host_crops:
+                    (q_raw, k_raw), _ = self._put_crop_batch(
+                        idx, epoch, step, n_crops=2,
+                        scale=self.recipe.crop_scale,
+                        out_size=self.config.image_size,
+                    )  # two (B, S, S, 3) sharded views
+                    yield self._augment_precropped(step_rng, q_raw, k_raw)
+                else:
+                    raw, _ = self._put_batch(idx)
+                    yield self._augment(step_rng, raw)
 
         return _prefetch(gen(), depth=2)
 
@@ -170,8 +235,8 @@ class LabeledPipeline(_HostPipeline):
     def __init__(self, config: DataConfig, mesh: Mesh, seed: int = 0, dataset=None):
         super().__init__(config, mesh, seed=seed, dataset=dataset, train=True, drop_last=True)
         base = get_recipe(config.aug_plus, config.image_size)
-        recipe = PROBE_RECIPE._replace(mean=base.mean, std=base.std)
-        out_size = config.image_size
+        self.recipe = PROBE_RECIPE._replace(mean=base.mean, std=base.std)
+        recipe, out_size = self.recipe, config.image_size
 
         @jax.jit
         def _augment(rng, raw_uint8):
@@ -179,6 +244,14 @@ class LabeledPipeline(_HostPipeline):
             return apply_recipe(recipe, rng, images, out_size)
 
         self._augment = _augment
+        nocrop = recipe._replace(crop=False)
+
+        @jax.jit
+        def _augment_precropped(rng, raw_uint8):
+            images = raw_uint8.astype(jnp.float32) / 255.0
+            return apply_recipe(nocrop, rng, images, out_size)
+
+        self._augment_precropped = _augment_precropped
 
     def epoch(self, epoch: int) -> Iterator[tuple]:
         order, rng = self._epoch_order(epoch), self._epoch_rng(epoch)
@@ -186,9 +259,17 @@ class LabeledPipeline(_HostPipeline):
         def gen():
             for step in range(self.steps_per_epoch):
                 idx = order[step * self.batch_size : (step + 1) * self.batch_size]
-                raw, labels = self._put_batch(idx)
                 step_rng = jax.random.fold_in(rng, step)
-                yield self._augment(step_rng, raw), labels
+                if self.host_crops:
+                    (raw,), labels = self._put_crop_batch(
+                        idx, epoch, step, n_crops=1,
+                        scale=self.recipe.crop_scale,
+                        out_size=self.config.image_size,
+                    )
+                    yield self._augment_precropped(step_rng, raw), labels
+                else:
+                    raw, labels = self._put_batch(idx)
+                    yield self._augment(step_rng, raw), labels
 
         return _prefetch(gen(), depth=2)
 

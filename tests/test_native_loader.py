@@ -131,3 +131,63 @@ def test_pipeline_uses_native_batch(image_dir):
     pipe = TwoCropPipeline(cfg, mesh, dataset=nat)
     batch = next(iter(pipe.epoch(0)))
     assert batch["im_q"].shape == (4, 32, 32, 3)
+
+
+def test_get_dims_matches_originals(image_dir):
+    root, paths = image_dir
+    loader = NativeBatchLoader(paths, canvas=32, threads=2)
+    dims = loader.get_dims(np.arange(len(paths)))
+    for i, p in enumerate(paths):
+        with Image.open(p) as im:
+            w, h = im.size
+        assert tuple(dims[i]) == (h, w)
+    # cached second call identical
+    np.testing.assert_array_equal(dims, loader.get_dims(np.arange(len(paths))))
+
+
+def test_load_crops_parity_with_pil(image_dir):
+    """Native region-resize == PIL crop+resize (both BILINEAR antialias),
+    for boxes sampled against ORIGINAL geometry — the exact-crop path of
+    VERDICT r1 weak-item 6."""
+    root, paths = image_dir
+    loader = NativeBatchLoader(paths, canvas=32, threads=2)
+    idx = np.arange(len(paths))
+    dims = loader.get_dims(idx)
+    from moco_tpu.data.datasets import sample_rrc_boxes
+
+    rng = np.random.default_rng(3)
+    boxes = np.stack(
+        [sample_rrc_boxes(rng, dims), sample_rrc_boxes(rng, dims)], axis=1
+    )
+    out = loader.load_crops(idx, boxes, out_size=24)
+    assert out.shape == (len(paths), 2, 24, 24, 3)
+    for i, p in enumerate(paths):
+        with Image.open(p) as im:
+            im = im.convert("RGB")
+            for c in range(2):
+                y0, x0, ch, cw = boxes[i, c]
+                want = np.asarray(
+                    im.crop((x0, y0, x0 + cw, y0 + ch)).resize((24, 24), Image.BILINEAR),
+                    np.float32,
+                )
+                diff = np.abs(out[i, c].astype(np.float32) - want).mean()
+                assert diff < 6.0, f"img {i} crop {c}: mean abs diff {diff}"
+
+
+def test_imagefolder_crop_protocol_parity(image_dir):
+    """PIL ImageFolderDataset and NativeImageFolderDataset expose the same
+    host-crop protocol with matching outputs."""
+    from moco_tpu.data.datasets import ImageFolderDataset, sample_rrc_boxes
+
+    root, _ = image_dir
+    py = ImageFolderDataset(root, decode_size=32)
+    nat = NativeImageFolderDataset(root, decode_size=32)
+    idx = np.arange(len(py))
+    np.testing.assert_array_equal(py.dims(idx), nat.dims(idx))
+    boxes = sample_rrc_boxes(np.random.default_rng(0), py.dims(idx))[:, None]
+    a, la = py.load_crop_batch(idx, boxes, 16)
+    b, lb = nat.load_crop_batch(idx, boxes, 16)
+    np.testing.assert_array_equal(la, lb)
+    assert a.shape == b.shape == (len(py), 1, 16, 16, 3)
+    diff = np.abs(a.astype(np.float32) - b.astype(np.float32)).mean()
+    assert diff < 6.0
