@@ -1,0 +1,27 @@
+"""Platform pinning for CLI entry points.
+
+With a remote-TPU PJRT plugin registered at interpreter start (this
+environment's sitecustomize), setting ``JAX_PLATFORMS=cpu`` in the
+environment alone is not always honored — backend probing can still
+contact the remote terminal and hang if it is unreachable. The fix is a
+CONFIG-level pin before any backend use (what `tests/conftest.py` and
+`__graft_entry__.dryrun_multichip` already do); every CLI calls
+:func:`pin_platform_from_env` first so `JAX_PLATFORMS=cpu python
+train.py ...` behaves as a user expects.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def pin_platform_from_env() -> None:
+    """Mirror a ``JAX_PLATFORMS`` env request into jax's config, before
+    any operation initializes a backend. No-op when the env var is unset
+    (the environment's default platform, e.g. the TPU tunnel, is used).
+    """
+    want = os.environ.get("JAX_PLATFORMS", "").strip().lower()
+    if want:
+        import jax
+
+        jax.config.update("jax_platforms", want)

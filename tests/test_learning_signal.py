@@ -1,0 +1,87 @@
+"""End-to-end learning signal at CI scale (VERDICT r1 item 2).
+
+The reference's only QA mechanism is end-to-end metric reproduction
+(SURVEY.md §4). This is its CI-sized equivalent: a short MoCo v2
+pretrain on the class-structured `LearnableSyntheticDataset` must push
+frozen-feature kNN top-1 well above chance. Runs on the 8-virtual-CPU
+mesh like the rest of the suite — small model, few epochs.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from moco_tpu.data.datasets import LearnableSyntheticDataset
+from moco_tpu.knn import knn_eval
+from moco_tpu.train import train
+from moco_tpu.utils.config import (
+    DataConfig,
+    MocoConfig,
+    OptimConfig,
+    ParallelConfig,
+    TrainConfig,
+)
+
+NUM_CLASSES = 8
+CHANCE = 100.0 / NUM_CLASSES
+
+
+@pytest.mark.slow
+def test_pretrain_knn_beats_chance(tmp_path):
+    n_dev = len(jax.devices())
+    config = TrainConfig(
+        moco=MocoConfig(
+            arch="resnet18",
+            dim=64,
+            num_negatives=256,
+            momentum=0.9,
+            temperature=0.2,
+            mlp=True,
+            shuffle="gather_perm" if n_dev > 1 else "none",
+            cifar_stem=True,
+            compute_dtype="float32",
+        ),
+        optim=OptimConfig(lr=0.12, epochs=4, cos=True),
+        data=DataConfig(
+            dataset="synthetic_learnable", image_size=32, global_batch=64, aug_plus=True
+        ),
+        parallel=ParallelConfig(num_data=n_dev),
+        workdir=str(tmp_path),
+        knn_every_epochs=0,
+        seed=0,
+    )
+    dataset = LearnableSyntheticDataset(512, 32, NUM_CLASSES, train=True)
+    final = train(config, dataset=dataset)
+    assert np.isfinite(final["loss"])
+
+    # frozen-feature kNN on held-out instances of the same classes
+    from moco_tpu.core import build_encoder
+    from moco_tpu.utils.checkpoint import CheckpointManager
+    from moco_tpu.core.moco import create_state
+    from moco_tpu.utils.schedules import build_optimizer
+    import jax.numpy as jnp
+
+    encoder = build_encoder(config.moco, num_data=n_dev)
+    tx = build_optimizer(config.optim, steps_per_epoch=8)
+    sample = jnp.zeros((1, 32, 32, 3), jnp.float32)
+    state = create_state(jax.random.PRNGKey(0), config, encoder, tx, sample)
+    ckpt = CheckpointManager(str(tmp_path), keep=3)
+    state, _ = ckpt.restore(state)
+    ckpt.close()
+
+    bank = LearnableSyntheticDataset(512, 32, NUM_CLASSES, train=True)
+    test = LearnableSyntheticDataset(128, 32, NUM_CLASSES, train=False)
+    top1 = knn_eval(
+        encoder.backbone,
+        state.params_q["backbone"],
+        state.batch_stats_q.get("backbone", {}),
+        bank,
+        test,
+        num_classes=NUM_CLASSES,
+        k=32,
+        image_size=32,
+    )
+    # chance is 12.5%; a learning encoder lands far above it even at
+    # this CI scale (typically >50%) — the margin guards against flaky
+    # near-chance passes without requiring a long run
+    assert top1 > 2.0 * CHANCE, f"kNN top-1 {top1:.1f}% not above 2x chance"

@@ -55,7 +55,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--warmup-epochs", type=int, default=None)
     p.add_argument("--epochs", type=int, default=None)
     # data (reference: positional DATA, --batch-size, --aug-plus, --workers)
-    p.add_argument("--data", dest="dataset", choices=("synthetic", "cifar10", "imagefolder"), default=None)
+    p.add_argument("--data", dest="dataset", choices=("synthetic", "synthetic_learnable", "cifar10", "imagefolder"), default=None)
     p.add_argument("--data-dir", default=None)
     p.add_argument("--image-size", type=int, default=None)
     p.add_argument("--batch-size", "-b", type=int, default=None)
@@ -121,6 +121,9 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
 
 def main() -> None:
     args = build_parser().parse_args()
+    from moco_tpu.utils.platform import pin_platform_from_env
+
+    pin_platform_from_env()
     config = config_from_args(args)
     from moco_tpu.train import train
 
