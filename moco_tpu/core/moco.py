@@ -65,14 +65,26 @@ def create_backbone(cfg: MocoConfig, num_data: Optional[int] = None) -> nn.Modul
     """Backbone factory shared by pretraining and the linear probe:
     ResNet family or ViT family from `cfg.arch`."""
     dtype = jnp.dtype(cfg.compute_dtype)
+    if cfg.vit_sequence_parallel and not cfg.arch.startswith("vit"):
+        # must fail HERE, not just in the vit branch: v3_step keys its
+        # backbone-grad psum on this flag, and a silently-ignored flag on
+        # a ResNet would double backbone grads over the model axis
+        raise ValueError(f"vit_sequence_parallel requires a ViT arch, got {cfg.arch!r}")
     if cfg.arch.startswith("vit"):
         from moco_tpu.models.vit import create_vit
 
         vit_kw = {"patch_size": cfg.vit_patch_size} if cfg.vit_patch_size else {}
+        if cfg.vit_sequence_parallel:
+            if not cfg.v3:
+                raise ValueError("vit_sequence_parallel requires the v3 (queue-free) step")
+            if cfg.vit_pool != "gap":
+                raise ValueError("vit_sequence_parallel requires vit_pool='gap'")
+            vit_kw["sequence_axis"] = MODEL_AXIS
         return create_vit(
             cfg.arch,
             dtype=dtype,
             use_flash_attention=cfg.vit_flash_attention,
+            pool=cfg.vit_pool,
             **vit_kw,
         )
     syncbn_axis = DATA_AXIS if cfg.shuffle == "syncbn" else None
@@ -348,6 +360,15 @@ def make_train_step(
         if cfg.freeze_patch_embed and "patch_embed" in grads["enc"].get("backbone", {}):
             grads["enc"]["backbone"]["patch_embed"] = jax.tree.map(
                 jnp.zeros_like, grads["enc"]["backbone"]["patch_embed"]
+            )
+        if cfg.vit_sequence_parallel:
+            # Sequence parallelism: each model-axis member backprops only
+            # through ITS token shard, so backbone grads are PARTIAL sums
+            # — psum over the sequence (model) axis restores the full
+            # gradient. Head/predictor grads are replicated-identical
+            # (they consume the psum-pooled feature) and stay untouched.
+            grads["enc"]["backbone"] = lax.psum(
+                grads["enc"]["backbone"], MODEL_AXIS
             )
         grads = lax.pmean(grads, DATA_AXIS)
         metrics = {"loss": loss, **topk_accuracy(logits, labels)}

@@ -26,12 +26,13 @@ tokens); at 197 it is a correctness-exercised alternative, not a win.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Callable, Optional
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 
 def flash_attention_fn(query, key, value, **kwargs):
@@ -45,6 +46,27 @@ def flash_attention_fn(query, key, value, **kwargs):
     v = value.transpose(0, 2, 1, 3)
     out = flash_attention(q, k, v, interpret=jax.default_backend() != "tpu")
     return out.transpose(0, 2, 1, 3)
+
+
+def ring_attention_fn(axis_name: str):
+    """attention_fn computing EXACT attention over a sequence sharded on
+    `axis_name`: per-device flash attention against the visiting K/V
+    shard, rotated around the ring with ppermute
+    (`moco_tpu/parallel/ring_attention.py`). Must run inside `shard_map`
+    with the token axis sharded on `axis_name`."""
+
+    def fn(query, key, value, **kwargs):
+        from moco_tpu.parallel.ring_attention import ring_attention
+
+        q = query.transpose(0, 2, 1, 3)
+        k = key.transpose(0, 2, 1, 3)
+        v = value.transpose(0, 2, 1, 3)
+        out = ring_attention(
+            q, k, v, axis_name, interpret=jax.default_backend() != "tpu"
+        )
+        return out.transpose(0, 2, 1, 3)
+
+    return fn
 
 
 def sincos_2d_posembed(dim: int, grid: int, cls_token: bool = True) -> np.ndarray:
@@ -83,13 +105,16 @@ class EncoderBlock(nn.Module):
     mlp_dim: int
     dtype: jnp.dtype = jnp.float32
     use_flash_attention: bool = False
+    # explicit attention_fn override (e.g. ring_attention_fn for the
+    # sequence-parallel path); takes precedence over use_flash_attention.
+    # The parameter tree is identical for every attention implementation.
+    attention_fn: Optional[Callable] = None
 
     @nn.compact
     def __call__(self, x):
         y = nn.LayerNorm(dtype=self.dtype)(x)
-        attn_kwargs = (
-            {"attention_fn": flash_attention_fn} if self.use_flash_attention else {}
-        )
+        fn = self.attention_fn or (flash_attention_fn if self.use_flash_attention else None)
+        attn_kwargs = {"attention_fn": fn} if fn is not None else {}
         y = nn.MultiHeadDotProductAttention(
             num_heads=self.num_heads, dtype=self.dtype, deterministic=True, **attn_kwargs
         )(y, y)
@@ -100,9 +125,22 @@ class EncoderBlock(nn.Module):
 
 
 class VisionTransformer(nn.Module):
-    """ViT returning the final-LN cls-token feature (pre-head), the
+    """ViT returning the final-LN pooled feature (pre-head), the
     interface shape `ResNet.__call__` has, so `MoCoEncoder` composes
-    either backbone unchanged."""
+    either backbone unchanged.
+
+    `pool`: "cls" (v3 default) or "gap" (global average pool, the v3
+    paper's ablated alternative — and the mode sequence parallelism
+    requires, since a cls token cannot be sharded).
+
+    `sequence_axis`: name of a mesh axis to shard the TOKEN dimension
+    over. When the module is applied inside `shard_map` with that axis
+    bound, each device patchifies the (replicated) image, keeps only its
+    token shard, runs the blocks with ring attention (exact attention
+    over the full sequence via ppermute rotation), and gap-pools with a
+    psum. Applied OUTSIDE shard_map (init, kNN, export) the same module
+    falls back to the dense single-device path — the parameter tree is
+    identical, so one set of weights serves both."""
 
     patch_size: int = 16
     hidden_dim: int = 768
@@ -112,6 +150,8 @@ class VisionTransformer(nn.Module):
     image_size: int = 224
     dtype: jnp.dtype = jnp.float32
     use_flash_attention: bool = False
+    pool: str = "cls"
+    sequence_axis: Optional[str] = None
 
     @property
     def num_features(self) -> int:
@@ -123,6 +163,8 @@ class VisionTransformer(nn.Module):
         assert h % self.patch_size == 0 and w % self.patch_size == 0, (
             f"image {h}x{w} not divisible by patch {self.patch_size}"
         )
+        if self.pool not in ("cls", "gap"):
+            raise ValueError(f"pool={self.pool!r}: choose 'cls' or 'gap'")
         grid = h // self.patch_size
         x = x.astype(self.dtype)
         # Patch embedding: conv stride=patch (the "random patch projection"
@@ -136,22 +178,59 @@ class VisionTransformer(nn.Module):
             dtype=self.dtype,
         )(x)
         x = x.reshape(b, grid * grid, self.hidden_dim)
-        cls = self.param(
-            "cls_token", nn.initializers.normal(stddev=0.02), (1, 1, self.hidden_dim)
-        )
-        x = jnp.concatenate([jnp.broadcast_to(cls.astype(self.dtype), (b, 1, self.hidden_dim)), x], axis=1)
-        pos = sincos_2d_posembed(self.hidden_dim, grid)
+        if self.pool == "cls":
+            cls = self.param(
+                "cls_token", nn.initializers.normal(stddev=0.02), (1, 1, self.hidden_dim)
+            )
+            x = jnp.concatenate(
+                [jnp.broadcast_to(cls.astype(self.dtype), (b, 1, self.hidden_dim)), x],
+                axis=1,
+            )
+        pos = sincos_2d_posembed(self.hidden_dim, grid, cls_token=self.pool == "cls")
         x = x + jnp.asarray(pos, self.dtype)
+
+        # Sequence parallelism: bind to the axis if we are inside a
+        # shard_map that names it; otherwise (init / single-device eval)
+        # run dense. axis_index raises NameError at TRACE time when the
+        # axis is unbound, so the fallback costs nothing at runtime.
+        seq_total = x.shape[1]
+        sp_rank = None
+        if self.sequence_axis is not None:
+            try:
+                sp_rank = lax.axis_index(self.sequence_axis)
+                sp_n = lax.axis_size(self.sequence_axis)
+            except NameError:
+                sp_rank = None
+        if sp_rank is not None:
+            if self.pool != "gap":
+                raise ValueError("sequence_axis requires pool='gap' (cls token cannot be sharded)")
+            if seq_total % sp_n:
+                raise ValueError(
+                    f"{seq_total} tokens not divisible by sequence axis size {sp_n}"
+                )
+            local = seq_total // sp_n
+            x = lax.dynamic_slice_in_dim(x, sp_rank * local, local, axis=1)
+            attn_fn = ring_attention_fn(self.sequence_axis)
+        else:
+            attn_fn = None
+
         for i in range(self.depth):
             x = EncoderBlock(
                 num_heads=self.num_heads,
                 mlp_dim=self.mlp_dim,
                 dtype=self.dtype,
                 use_flash_attention=self.use_flash_attention,
+                attention_fn=attn_fn,
                 name=f"block_{i}",
             )(x)
         x = nn.LayerNorm(dtype=self.dtype, name="final_norm")(x)
-        return x[:, 0].astype(jnp.float32)  # cls token
+        if self.pool == "cls":
+            return x[:, 0].astype(jnp.float32)
+        # gap: mean over ALL tokens (psum across the shard ring when SP)
+        s = jnp.sum(x.astype(jnp.float32), axis=1)
+        if sp_rank is not None:
+            s = lax.psum(s, self.sequence_axis)
+        return s / seq_total
 
 
 _VIT_CONFIGS = {

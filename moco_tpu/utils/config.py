@@ -45,6 +45,14 @@ class MocoConfig:
     # parameter tree is identical to the dense path, so checkpoints are
     # interchangeable. Pays off at long sequences (high-res/video).
     vit_flash_attention: bool = False
+    # ViT feature pooling: "cls" (v3 default) or "gap" (global average
+    # pool — required by sequence parallelism).
+    vit_pool: str = "cls"
+    # Sequence parallelism for the ViT: shard the token axis over the
+    # mesh's MODEL axis and run ring attention across it (long-sequence
+    # regime: high-res images / video token counts). Requires v3, gap
+    # pooling, and tokens divisible by num_model.
+    vit_sequence_parallel: bool = False
     # Streaming pallas InfoNCE (no (B, 1+K) logits materialization):
     # None = auto (on for TPU + replicated tile-divisible queue).
     fused_infonce: Optional[bool] = None

@@ -8,6 +8,7 @@ device CPU mesh, simulating process boundaries with the
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from moco_tpu.parallel import (
@@ -114,3 +115,51 @@ def test_assemble_wrong_rowcount_raises():
         assert "local rows" in str(e)
     else:
         raise AssertionError("expected ValueError")
+
+
+class TestMultisliceMesh:
+    """create_multislice_mesh logic (slice counting, per-slice shape
+    math, DCN-outer layout) — hardware-independent via a stubbed
+    mesh_utils; no multi-slice TPU exists in CI."""
+
+    class _FakeDev:
+        def __init__(self, slice_index):
+            self.slice_index = slice_index
+
+    def test_single_slice_falls_back_to_flat_mesh(self):
+        from moco_tpu.parallel.mesh import create_multislice_mesh
+
+        mesh = create_multislice_mesh()
+        assert mesh.shape["data"] == len(jax.devices())
+        assert mesh.shape["model"] == 1
+
+    def test_hybrid_shapes_passed_to_mesh_utils(self, monkeypatch):
+        import moco_tpu.parallel.mesh as mesh_mod
+        from jax.experimental import mesh_utils
+
+        real = jax.devices()  # 8 virtual CPU devices
+        fakes = [self._FakeDev(i // 4) for i in range(8)]  # 2 slices x 4
+        monkeypatch.setattr(jax, "devices", lambda: fakes)
+        seen = {}
+
+        def stub(mesh_shape, dcn_mesh_shape, devices):
+            seen["mesh_shape"] = mesh_shape
+            seen["dcn_mesh_shape"] = dcn_mesh_shape
+            total = int(np.prod(mesh_shape)) * int(np.prod(dcn_mesh_shape))
+            shape = (dcn_mesh_shape[0] * mesh_shape[0], mesh_shape[1])
+            return np.array(real[:total]).reshape(shape)
+
+        monkeypatch.setattr(mesh_utils, "create_hybrid_device_mesh", stub)
+        mesh = mesh_mod.create_multislice_mesh(num_model=2)
+        # per slice: 4 chips / model 2 -> data 2; DCN outer: 2 slices
+        assert seen["mesh_shape"] == (2, 2)
+        assert seen["dcn_mesh_shape"] == (2, 1)
+        assert mesh.shape["data"] == 4 and mesh.shape["model"] == 2
+
+    def test_model_not_dividing_slice_raises(self, monkeypatch):
+        import moco_tpu.parallel.mesh as mesh_mod
+
+        fakes = [self._FakeDev(i // 4) for i in range(8)]
+        monkeypatch.setattr(jax, "devices", lambda: fakes)
+        with pytest.raises(ValueError, match="not divisible"):
+            mesh_mod.create_multislice_mesh(num_model=3)

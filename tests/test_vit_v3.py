@@ -8,6 +8,7 @@ CNN-only (SURVEY.md §5.7)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import dataclasses
 import pytest
 
 from moco_tpu.core import (
@@ -198,3 +199,95 @@ def test_vit_flash_attention_matches_dense():
     np.testing.assert_allclose(
         np.asarray(out_dense), np.asarray(out_flash), rtol=2e-4, atol=2e-4
     )
+
+
+class TestSequenceParallelViT:
+    """Sequence parallelism: tokens sharded over the mesh's model axis,
+    ring attention across shards (the long-context path, SURVEY.md §5.7
+    'beyond reference'). Parity against the dense single-device ViT."""
+
+    def _vit(self, **kw):
+        return create_vit("vit_tiny", image_size=32, patch_size=4, pool="gap", **kw)
+
+    def test_forward_matches_dense(self):
+        from jax.sharding import PartitionSpec as P
+
+        mesh = create_mesh(num_data=1, num_model=8)
+        vit_sp = self._vit(sequence_axis="model")
+        vit_dense = self._vit()
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 32, 32, 3))
+        params = vit_dense.init(jax.random.PRNGKey(1), x)
+        # identical param trees: SP is a compute-path choice, not a model
+        assert jax.tree.structure(params) == jax.tree.structure(
+            vit_sp.init(jax.random.PRNGKey(1), x)
+        )
+        want = vit_dense.apply(params, x)
+
+        def fwd(params, x):
+            return vit_sp.apply(params, x)
+
+        got = jax.jit(
+            jax.shard_map(
+                fwd, mesh=mesh, in_specs=(P(), P()), out_specs=P(), check_vma=False
+            )
+        )(params, x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)
+
+    def test_outside_shard_map_falls_back_dense(self):
+        vit_sp = self._vit(sequence_axis="model")
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 32, 32, 3))
+        params = vit_sp.init(jax.random.PRNGKey(1), x)
+        out = vit_sp.apply(params, x)  # no axis bound -> dense path
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(self._vit().apply(params, x)), rtol=1e-5, atol=1e-5
+        )
+
+    def _sp_config(self, num_model: int) -> TrainConfig:
+        cfg = _v3_config(4)
+        return dataclasses.replace(
+            cfg,
+            moco=dataclasses.replace(
+                cfg.moco, vit_pool="gap", vit_sequence_parallel=num_model > 0
+            ),
+        )
+
+    def test_v3_train_step_with_sp_matches_dense(self):
+        """One v3 step on a (4, 2) mesh with token-sharded ViT == the same
+        step on (4, 1) dense — loss and updated params agree."""
+        results = {}
+        for num_model in (1, 2):
+            config = self._sp_config(num_model if num_model > 1 else 0)
+            mesh = create_mesh(num_data=4, num_model=num_model)
+            encoder = build_encoder(config.moco, num_data=4)
+            predictor = build_predictor(config.moco, num_data=4)
+            from moco_tpu.utils.schedules import build_optimizer
+
+            tx = build_optimizer(config.optim, steps_per_epoch=2)
+            from moco_tpu.core import create_state, make_train_step, place_state
+
+            sample = jnp.zeros((1, IMG, IMG, 3), jnp.float32)
+            state = create_state(
+                jax.random.PRNGKey(0), config, encoder, tx, sample, predictor=predictor
+            )
+            state = place_state(state, mesh)
+            step = make_train_step(
+                config, encoder, tx, mesh, predictor=predictor, total_steps=4
+            )
+            ims = jax.random.normal(jax.random.PRNGKey(5), (2, 16, IMG, IMG, 3))
+            batch = shard_batch(mesh, {"im_q": ims[0], "im_k": ims[1]})
+            rng = jax.device_put(
+                jax.random.PRNGKey(7),
+                jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec()),
+            )
+            new_state, metrics = step(state, batch, rng)
+            results[num_model] = (
+                float(metrics["loss"]),
+                np.asarray(
+                    jax.tree.leaves(new_state.params_q)[0], dtype=np.float64
+                ),
+            )
+        loss_dense, leaf_dense = results[1]
+        loss_sp, leaf_sp = results[2]
+        assert np.isfinite(loss_sp)
+        np.testing.assert_allclose(loss_sp, loss_dense, rtol=1e-4)
+        np.testing.assert_allclose(leaf_sp, leaf_dense, rtol=1e-3, atol=1e-5)
