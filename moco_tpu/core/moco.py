@@ -47,6 +47,7 @@ from moco_tpu.parallel.shuffle import (
     shuffle_gather,
     unshuffle_gather,
 )
+from moco_tpu.parallel.zero import shard_template, sharded_update
 from moco_tpu.utils.config import MocoConfig, TrainConfig
 
 
@@ -171,7 +172,12 @@ def create_state(
     tx,
     sample_input: jax.Array,
     predictor: Optional[nn.Module] = None,
+    zero_num_data: Optional[int] = None,
 ) -> MocoState:
+    """`zero_num_data`: when config.parallel.shard_weight_update is on,
+    the data-axis size — the optimizer state is then initialized in the
+    (n, m) sharded-flat layout (moco_tpu/parallel/zero.py) instead of the
+    param tree's shapes."""
     p_rng, q_rng, pred_rng = jax.random.split(rng, 3)
     variables = encoder.init(p_rng, sample_input, train=False)
     params = variables["params"]
@@ -180,7 +186,9 @@ def create_state(
     queue = (
         init_queue(q_rng, cfg.num_negatives, cfg.dim)
         if cfg.num_negatives > 0
-        else jnp.zeros((0, cfg.dim), jnp.float32)
+        # queue-free (v3): a 1-row placeholder, never read by the step —
+        # a (0, dim) array would be rejected by Orbax at checkpoint save
+        else jnp.zeros((1, cfg.dim), jnp.float32)
     )
     params_pred, stats_pred = {}, {}
     if predictor is not None:
@@ -197,18 +205,36 @@ def create_state(
         batch_stats_k=jax.tree.map(jnp.copy, batch_stats),
         queue=queue,
         queue_ptr=jnp.zeros((), jnp.int32),
-        # one optimizer over every trainable leaf: encoder_q (+ predictor)
-        opt_state=tx.init({"enc": params, "pred": params_pred}),
+        # one optimizer over every trainable leaf: encoder_q (+ predictor);
+        # with sharded weight update the state lives in the (n, m)
+        # sharded-flat layout instead
+        opt_state=tx.init(
+            {"enc": params, "pred": params_pred}
+            if not (config.parallel.shard_weight_update and zero_num_data)
+            else shard_template({"enc": params, "pred": params_pred}, zero_num_data)
+        ),
         params_pred=params_pred,
         batch_stats_pred=stats_pred,
     )
 
 
-def state_specs(shard_queue_over_model: bool) -> MocoState:
+def state_specs(
+    shard_queue_over_model: bool, zero_opt_state: Optional[Any] = None
+) -> MocoState:
     """PartitionSpec pytree for MocoState: everything replicated except,
     optionally, the queue rows sharded over the model axis (tensor
-    parallelism for very large dictionaries)."""
+    parallelism for very large dictionaries) and — with sharded weight
+    update — the optimizer state's (n, m) leaves sharded over `data`
+    (`zero_opt_state` is a concrete opt-state tree to derive per-leaf
+    specs from; its 2-D leaves are the sharded ones, scalars replicate).
+    """
     qspec = P(MODEL_AXIS, None) if shard_queue_over_model else P()
+    opt_spec: Any = P()
+    if zero_opt_state is not None:
+        opt_spec = jax.tree.map(
+            lambda x: P(DATA_AXIS, None) if getattr(x, "ndim", 0) == 2 else P(),
+            zero_opt_state,
+        )
     return MocoState(
         step=P(),
         params_q=P(),
@@ -217,7 +243,7 @@ def state_specs(shard_queue_over_model: bool) -> MocoState:
         batch_stats_k=P(),
         queue=qspec,
         queue_ptr=P(),
-        opt_state=P(),
+        opt_state=opt_spec,
         params_pred=P(),
         batch_stats_pred=P(),
     )
@@ -232,8 +258,13 @@ def make_train_step(
     donate: bool = False,
     predictor: Optional[nn.Module] = None,
     total_steps: Optional[int] = None,
+    state_template: Optional[MocoState] = None,
 ) -> Callable:
     """Builds the jitted SPMD train step over `mesh`.
+
+    `state_template`: required when config.parallel.shard_weight_update
+    is on — a concrete (un-placed is fine) MocoState whose opt_state tree
+    provides the per-leaf sharding specs of the ZeRO layout.
 
     batch: {'im_q': (B_global,H,W,C), 'im_k': ...} fp32, already augmented
     (host- or device-side); sharded over the `data` axis.
@@ -265,6 +296,14 @@ def make_train_step(
         shard_queue_over_model = n_model > 1 and cfg.num_negatives > 0
     if shard_queue_over_model and cfg.num_negatives % (n_model * max(global_batch, 1)):
         raise ValueError("sharded queue requires K % (num_model*global_batch) == 0")
+    zero = config.parallel.shard_weight_update
+    if zero:
+        if config.optim.optimizer == "lars":
+            # LARS trust ratios need whole-tensor norms; a flat shard
+            # cannot compute them (moco_tpu/parallel/zero.py docstring)
+            raise ValueError("shard_weight_update supports element-wise optimizers only (sgd/adamw), not lars")
+        if state_template is None:
+            raise ValueError("shard_weight_update needs state_template for the opt-state sharding specs")
     # Fused streaming InfoNCE (pallas): auto-on for a TPU backend with a
     # replicated, tile-divisible queue; explicit True forces it (interpret
     # mode off-TPU), False forces the dense logits path.
@@ -370,21 +409,38 @@ def make_train_step(
             grads["enc"]["backbone"] = lax.psum(
                 grads["enc"]["backbone"], MODEL_AXIS
             )
-        grads = lax.pmean(grads, DATA_AXIS)
         metrics = {"loss": loss, **topk_accuracy(logits, labels)}
         metrics = lax.pmean(metrics, DATA_AXIS)
         stats_q = lax.pmean(stats_q, DATA_AXIS)
         stats_k = lax.pmean(stats_k, DATA_AXIS)
         stats_pred = lax.pmean(stats_pred, DATA_AXIS)
 
-        updates, opt_state = tx.update(grads, state.opt_state, trainable)
-        if cfg.freeze_patch_embed and "patch_embed" in updates["enc"].get("backbone", {}):
-            # zeroed grads are not enough: AdamW's decoupled weight decay
-            # still moves zero-grad params, so zero the *update* as well
-            updates["enc"]["backbone"]["patch_embed"] = jax.tree.map(
-                jnp.zeros_like, updates["enc"]["backbone"]["patch_embed"]
+        if zero:
+            # Sharded weight update (parallel/zero.py): psum_scatter
+            # fuses the grad mean-reduction with the 1/n sharding. The
+            # patch-embed freeze is applied to the gathered FULL params
+            # below, so AdamW's decoupled decay cannot move them either.
+            frozen_pe = (
+                trainable["enc"]["backbone"]["patch_embed"]
+                if cfg.freeze_patch_embed
+                and "patch_embed" in trainable["enc"].get("backbone", {})
+                else None
             )
-        new_trainable = optax.apply_updates(trainable, updates)
+            new_trainable, opt_state = sharded_update(
+                tx, grads, state.opt_state, trainable
+            )
+            if frozen_pe is not None:
+                new_trainable["enc"]["backbone"]["patch_embed"] = frozen_pe
+        else:
+            grads = lax.pmean(grads, DATA_AXIS)
+            updates, opt_state = tx.update(grads, state.opt_state, trainable)
+            if cfg.freeze_patch_embed and "patch_embed" in updates["enc"].get("backbone", {}):
+                # zeroed grads are not enough: AdamW's decoupled weight decay
+                # still moves zero-grad params, so zero the *update* as well
+                updates["enc"]["backbone"]["patch_embed"] = jax.tree.map(
+                    jnp.zeros_like, updates["enc"]["backbone"]["patch_embed"]
+                )
+            new_trainable = optax.apply_updates(trainable, updates)
         new_state = state.replace(
             step=state.step + 1,
             params_q=new_trainable["enc"],
@@ -482,8 +538,6 @@ def make_train_step(
         # its own negative shard's contribution, so they must also be
         # pmean'd over MODEL — the factor M cancels exactly, restoring the
         # replicated-params invariant.
-        grad_axes = (DATA_AXIS, MODEL_AXIS) if shard_queue_over_model else DATA_AXIS
-        grads = lax.pmean(grads, grad_axes)
         metrics = {"loss": loss, **acc}
         metrics = lax.pmean(metrics, DATA_AXIS)
         # Running BN stats: average across devices (strictly better than
@@ -491,9 +545,23 @@ def make_train_step(
         stats_q = lax.pmean(stats_q, DATA_AXIS)
         stats_k = lax.pmean(stats_k, DATA_AXIS)
 
-        # (5) Optimizer update (replicated, identical on all devices).
-        updates, opt_state = tx.update(grads, state.opt_state, trainable)
-        params_q = optax.apply_updates(trainable, updates)["enc"]
+        # (5) Optimizer update: replicated full update, or — with
+        # shard_weight_update — ZeRO-style (parallel/zero.py): the grad
+        # psum_scatter replaces the pmean at identical comm volume, the
+        # optimizer touches only this replica's 1/n shard, and an
+        # all_gather rebuilds the full params.
+        if zero:
+            if shard_queue_over_model:
+                grads = lax.pmean(grads, MODEL_AXIS)
+            new_trainable, opt_state = sharded_update(
+                tx, grads, state.opt_state, trainable
+            )
+            params_q = new_trainable["enc"]
+        else:
+            grad_axes = (DATA_AXIS, MODEL_AXIS) if shard_queue_over_model else DATA_AXIS
+            grads = lax.pmean(grads, grad_axes)
+            updates, opt_state = tx.update(grads, state.opt_state, trainable)
+            params_q = optax.apply_updates(trainable, updates)["enc"]
 
         # (6) FIFO enqueue of the global key batch
         # (moco/builder.py:~L62-77); with a model-sharded queue each shard
@@ -526,7 +594,10 @@ def make_train_step(
         )
         return new_state, metrics
 
-    specs = state_specs(shard_queue_over_model)
+    specs = state_specs(
+        shard_queue_over_model,
+        zero_opt_state=state_template.opt_state if zero else None,
+    )
     batch_spec = {"im_q": P(DATA_AXIS), "im_k": P(DATA_AXIS)}
     sharded = jax.shard_map(
         step_fn,
@@ -555,12 +626,27 @@ def make_train_step(
     return jax.jit(sharded, **jit_kwargs)
 
 
-def place_state(state: MocoState, mesh: Mesh, shard_queue_over_model: bool = False) -> MocoState:
-    """device_put the state into the mesh shardings the train step expects."""
-    specs = state_specs(shard_queue_over_model)
+def place_state(
+    state: MocoState,
+    mesh: Mesh,
+    shard_queue_over_model: bool = False,
+    zero: bool = False,
+) -> MocoState:
+    """device_put the state into the mesh shardings the train step expects.
+    `zero=True` shards the (n, m) opt-state leaves over `data` (sharded
+    weight update, parallel/zero.py)."""
+    specs = state_specs(
+        shard_queue_over_model, zero_opt_state=state.opt_state if zero else None
+    )
     placed = {}
     for name in state.__dataclass_fields__:
         spec = getattr(specs, name)
-        sharding = NamedSharding(mesh, spec)
-        placed[name] = jax.tree.map(lambda x: jax.device_put(x, sharding), getattr(state, name))
+        value = getattr(state, name)
+        if isinstance(spec, P):  # one spec for the whole subtree
+            sharding = NamedSharding(mesh, spec)
+            placed[name] = jax.tree.map(lambda x: jax.device_put(x, sharding), value)
+        else:  # per-leaf spec tree (ZeRO opt state)
+            placed[name] = jax.tree.map(
+                lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), value, spec
+            )
     return MocoState(**placed)

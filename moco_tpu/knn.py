@@ -21,25 +21,50 @@ import jax.numpy as jnp
 import numpy as np
 
 from moco_tpu.ops.losses import l2_normalize
+from moco_tpu.parallel.mesh import DATA_AXIS
 
 
 def extract_features(
-    backbone, params, batch_stats, dataset, batch_size: int = 256, image_size: Optional[int] = None
+    backbone,
+    params,
+    batch_stats,
+    dataset,
+    batch_size: int = 256,
+    image_size: Optional[int] = None,
+    mesh=None,
 ) -> tuple[np.ndarray, np.ndarray]:
     """L2-normalized backbone features + labels for a whole dataset.
-    Center-crop-free: datasets decode to a fixed canvas already."""
+    Center-crop-free: datasets decode to a fixed canvas already.
+
+    With `mesh`, full batches are sharded over the `data` axis so
+    extraction data-parallelizes across the mesh (params replicated);
+    the ragged tail batch runs single-device."""
     from moco_tpu.data.augment import get_recipe, normalize
 
     recipe = get_recipe(False, image_size or 224)
 
-    @jax.jit
-    def forward(raw):
+    def forward_fn(raw):
         x = raw.astype(jnp.float32) / 255.0
         x = normalize(x, recipe.mean, recipe.std)
         feats = backbone.apply(
             {"params": params, "batch_stats": batch_stats}, x, train=False
         )
         return l2_normalize(feats)
+
+    forward = jax.jit(forward_fn)
+    shard = None
+    # Single-controller only: plain device_put cannot target a mesh with
+    # non-addressable devices; multi-host falls back to per-process
+    # single-device extraction (the bank/test sets are small).
+    if mesh is not None and jax.process_count() == 1:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        shard = NamedSharding(mesh, P(DATA_AXIS))
+        forward_sharded = jax.jit(forward_fn, out_shardings=NamedSharding(mesh, P()))
+        # keep every full batch divisible by the data axis so the sharded
+        # path actually serves them (not just shapes that happen to fit)
+        n_axis = mesh.shape[DATA_AXIS]
+        batch_size = -(-batch_size // n_axis) * n_axis
 
     feats_out, labels_out = [], []
     n = len(dataset)
@@ -51,7 +76,11 @@ def extract_features(
             loads = [dataset.load(int(i)) for i in idx]
             raw = np.stack([im for im, _ in loads])
             labels = np.asarray([l for _, l in loads], np.int32)
-        feats_out.append(np.asarray(forward(jnp.asarray(raw))))
+        if shard is not None and len(idx) % mesh.shape[DATA_AXIS] == 0:
+            feats = forward_sharded(jax.device_put(raw, shard))
+        else:  # no mesh, or ragged tail: single device
+            feats = forward(jnp.asarray(raw))
+        feats_out.append(np.asarray(feats))
         labels_out.append(np.asarray(labels, np.int32))
     return np.concatenate(feats_out), np.concatenate(labels_out)
 
@@ -96,13 +125,15 @@ def knn_eval(
     temperature: float = 0.07,
     batch_size: int = 256,
     image_size: Optional[int] = None,
+    mesh=None,
 ) -> float:
-    """kNN top-1 (%) of frozen features — the cheap probe proxy."""
+    """kNN top-1 (%) of frozen features — the cheap probe proxy.
+    `mesh` data-parallelizes feature extraction over its `data` axis."""
     train_f, train_y = extract_features(
-        backbone, params, batch_stats, train_dataset, batch_size, image_size
+        backbone, params, batch_stats, train_dataset, batch_size, image_size, mesh=mesh
     )
     test_f, test_y = extract_features(
-        backbone, params, batch_stats, test_dataset, batch_size, image_size
+        backbone, params, batch_stats, test_dataset, batch_size, image_size, mesh=mesh
     )
     preds = knn_classify(train_f, train_y, test_f, num_classes, k, temperature)
     return float(100.0 * np.mean(preds == test_y))

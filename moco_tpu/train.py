@@ -72,7 +72,11 @@ def train(
     rng = jax.random.PRNGKey(config.seed)
     init_rng, shuffle_rng = jax.random.split(rng)
     sample = jnp.zeros((1, config.data.image_size, config.data.image_size, 3), jnp.float32)
-    state = create_state(init_rng, config, encoder, tx, sample, predictor=predictor)
+    zero = config.parallel.shard_weight_update
+    state = create_state(
+        init_rng, config, encoder, tx, sample, predictor=predictor,
+        zero_num_data=num_data if zero else None,
+    )
 
     # Checkpoint ids are the GLOBAL STEP (unique and monotonic even for
     # mid-epoch preemption saves); the epoch lives in extras. Save
@@ -85,7 +89,6 @@ def train(
         print(f"resumed from epoch {start_epoch - 1} (step {int(state.step)})")
 
     shard_q = config.parallel.num_model > 1 and config.moco.num_negatives > 0
-    state = place_state(state, mesh, shard_queue_over_model=shard_q)
     step_fn = make_train_step(
         config,
         encoder,
@@ -94,7 +97,9 @@ def train(
         shard_queue_over_model=shard_q,
         predictor=predictor,
         total_steps=config.optim.epochs * steps_per_epoch,
+        state_template=state if zero else None,
     )
+    state = place_state(state, mesh, shard_queue_over_model=shard_q, zero=zero)
     root_rng = jax.device_put(
         shuffle_rng, jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
     )
@@ -173,6 +178,7 @@ def train(
             k=min(config.knn_k, len(bank)),
             temperature=config.knn_temperature,
             image_size=config.data.image_size,
+            mesh=mesh,  # extraction data-parallel over the mesh
         )
         print(f"Epoch [{epoch}] kNN top-1: {top1:.2f}%")
         return top1

@@ -101,6 +101,11 @@ class DataConfig:
 class ParallelConfig:
     num_data: Optional[int] = None  # None = all devices
     num_model: int = 1  # shards the queue/logits for very large K
+    # Sharded weight update (ZeRO-1 over the data axis, arXiv:2004.13336
+    # — moco_tpu/parallel/zero.py): optimizer state and update sharded
+    # 1/n per replica via psum_scatter + all_gather. Element-wise
+    # optimizers only (sgd/adamw).
+    shard_weight_update: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -213,5 +218,24 @@ PRESETS = {
             cos=True, warmup_epochs=40,
         ),
         data=DataConfig(dataset="imagefolder", aug_plus=True, global_batch=4096),
+    ),
+    # Long-sequence showcase (beyond the reference): 448px inputs give a
+    # 784-token ViT-B/16; tokens shard over an 8-way model axis with ring
+    # attention (gap pooling, --num-model 8). Sequence parallelism keeps
+    # per-chip attention memory at 1/8 of the full sequence.
+    "vit_b16_v3_highres_sp": TrainConfig(
+        moco=MocoConfig(
+            arch="vit_b16", dim=256, num_negatives=0, momentum=0.99,
+            momentum_cos=True, temperature=0.2, v3=True, shuffle="none",
+            vit_pool="gap", vit_sequence_parallel=True,
+        ),
+        optim=OptimConfig(
+            optimizer="adamw", lr=2.4e-3, weight_decay=0.1, epochs=300,
+            cos=True, warmup_epochs=40,
+        ),
+        data=DataConfig(
+            dataset="imagefolder", aug_plus=True, global_batch=1024, image_size=448
+        ),
+        parallel=ParallelConfig(num_model=8),
     ),
 }

@@ -49,12 +49,20 @@ def make_lr_schedule(cfg: OptimConfig, steps_per_epoch: int) -> Callable:
 
 
 def _bn_and_bias_mask(params):
-    """True for weight-decayable leaves: excludes biases and BN scale/bias
-    (standard for LARS; torch SGD in the reference decays everything)."""
+    """True for weight-decayable leaves: excludes biases and BN/LN
+    scale/bias (standard for LARS; torch SGD in the reference decays
+    everything).
+
+    Decayability is decided by the leaf's NAME only — every
+    non-decayable leaf in this codebase is literally named 'bias' or
+    'scale' — not by ndim: under sharded weight update
+    (parallel/zero.py) leaves arrive as 1-D flat shards with the same
+    tree paths, and an ndim test would silently disable decay there
+    (caught by tests/test_zero.py's adamw parity test)."""
 
     def decayable(path, x):
         name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
-        return x.ndim > 1 and name not in ("bias", "scale")
+        return name not in ("bias", "scale")
 
     return jax.tree_util.tree_map_with_path(decayable, params)
 

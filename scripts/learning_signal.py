@@ -35,6 +35,7 @@ def main() -> None:
     ap.add_argument("--workdir", default="/tmp/moco_signal")
     ap.add_argument("--epochs", type=int, default=30)
     ap.add_argument("--probe-epochs", type=int, default=15)
+    ap.add_argument("--probe-lr", type=float, default=0.5)
     ap.add_argument("--examples", type=int, default=4096)
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--queue", type=int, default=4096)
@@ -106,8 +107,13 @@ def main() -> None:
     print("pretrain final:", final)
 
     # ---- linear probe -------------------------------------------------
+    # probe lr scaled to this dataset size (the reference's lr=30 is an
+    # ImageNet/1000-way setting); step-decay at 2/3 and 5/6 of the run
     probe = ProbeConfig(
-        num_classes=num_classes, lr=1.0, epochs=args.probe_epochs, schedule=(10, 13)
+        num_classes=num_classes,
+        lr=args.probe_lr,
+        epochs=args.probe_epochs,
+        schedule=(max(args.probe_epochs * 2 // 3, 1), max(args.probe_epochs * 5 // 6, 2)),
     )
     probe_metrics = train_lincls(
         args.workdir,

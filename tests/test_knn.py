@@ -56,3 +56,31 @@ def test_extract_features_normalized():
     )
     assert feats.shape == (8, backbone.num_features)
     np.testing.assert_allclose(np.linalg.norm(feats, axis=1), 1.0, rtol=1e-5)
+
+
+def test_extract_features_sharded_matches_single_device():
+    """mesh-parallel extraction == single-device extraction."""
+    import jax
+
+    from moco_tpu.core import build_encoder
+    from moco_tpu.data.datasets import LearnableSyntheticDataset
+    from moco_tpu.knn import extract_features
+    from moco_tpu.parallel import create_mesh
+    from moco_tpu.utils.config import MocoConfig
+
+    cfg = MocoConfig(arch="resnet18", dim=32, cifar_stem=True, compute_dtype="float32", shuffle="none")
+    encoder = build_encoder(cfg)
+    ds = LearnableSyntheticDataset(40, 16, 4)  # 40 % 16 != 0: ragged tail
+    import jax.numpy as jnp
+
+    v = encoder.backbone.init(jax.random.PRNGKey(0), jnp.zeros((1, 16, 16, 3)), train=False)
+    mesh = create_mesh()
+    f1, y1 = extract_features(
+        encoder.backbone, v["params"], v.get("batch_stats", {}), ds, batch_size=16, image_size=16
+    )
+    f2, y2 = extract_features(
+        encoder.backbone, v["params"], v.get("batch_stats", {}), ds,
+        batch_size=16, image_size=16, mesh=mesh,
+    )
+    np.testing.assert_array_equal(y1, y2)
+    np.testing.assert_allclose(f1, f2, rtol=2e-5, atol=2e-5)

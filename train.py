@@ -33,7 +33,7 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description="MoCo TPU pretraining")
     p.add_argument("--preset", choices=sorted(PRESETS), default=None)
     # model (reference: --arch, --moco-dim/k/m/t, --mlp)
-    p.add_argument("--arch", "-a", choices=ARCHS + ("vit_s16", "vit_b16"), default=None)
+    p.add_argument("--arch", "-a", choices=ARCHS + ("vit_s16", "vit_b16", "vit_l16"), default=None)
     p.add_argument("--moco-dim", type=int, default=None)
     p.add_argument("--moco-k", type=int, default=None)
     p.add_argument("--moco-m", type=float, default=None)
@@ -44,6 +44,28 @@ def build_parser() -> argparse.ArgumentParser:
         choices=("gather_perm", "a2a", "syncbn", "none"),
         default=None,
         help="BN-decorrelation strategy (reference Shuffle-BN == gather_perm)",
+    )
+    # ViT options (moco-v3 family)
+    p.add_argument(
+        "--v3", action="store_true", default=None,
+        help="MoCo v3: symmetric queue-free loss + prediction head (set --moco-k 0)",
+    )
+    p.add_argument(
+        "--moco-m-cos", action="store_true", default=None,
+        help="cosine-ramp the EMA momentum to 1.0 over training (v3 recipe)",
+    )
+    p.add_argument("--vit-pool", choices=("cls", "gap"), default=None)
+    p.add_argument(
+        "--vit-flash-attention", action="store_true", default=None,
+        help="ViT attention via the Pallas flash kernel",
+    )
+    p.add_argument(
+        "--vit-sequence-parallel", action="store_true", default=None,
+        help="shard ViT tokens over the model axis (ring attention); needs --vit-pool gap",
+    )
+    p.add_argument(
+        "--remat", action="store_true", default=None,
+        help="rematerialize the query forward in backward (less HBM, ~30%% more FLOPs)",
     )
     # optim (reference: --lr --momentum --wd --schedule --cos --epochs)
     p.add_argument("--optimizer", choices=("sgd", "lars", "adamw"), default=None)
@@ -61,9 +83,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--batch-size", "-b", type=int, default=None)
     p.add_argument("--aug-plus", action="store_true", default=None)
     p.add_argument("--workers", "-j", type=int, default=None)
+    p.add_argument(
+        "--no-host-rrc", dest="host_rrc", action="store_false", default=None,
+        help="disable host-side exact RandomResizedCrop (fall back to canvas decode + on-device crop)",
+    )
+    p.add_argument(
+        "--knn-every-epochs", type=int, default=None,
+        help="periodic frozen-feature kNN monitor (0 = off)",
+    )
     # parallel / infra
     p.add_argument("--num-data", type=int, default=None, help="data-axis size (default: all devices)")
     p.add_argument("--num-model", type=int, default=None, help="model-axis size (shards the queue)")
+    p.add_argument(
+        "--shard-weight-update", action="store_true", default=None,
+        help="ZeRO-1: shard optimizer state + weight update over the data axis (sgd/adamw)",
+    )
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--workdir", default=None)
     p.add_argument("--print-freq", "-p", type=int, default=None)
@@ -88,6 +122,12 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         temperature=args.moco_t,
         mlp=args.mlp,
         shuffle=args.shuffle,
+        v3=args.v3,
+        momentum_cos=args.moco_m_cos,
+        vit_pool=args.vit_pool,
+        vit_flash_attention=args.vit_flash_attention,
+        vit_sequence_parallel=args.vit_sequence_parallel,
+        remat=args.remat,
     )
     optim = override(
         cfg.optim,
@@ -108,14 +148,21 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         global_batch=args.batch_size,
         aug_plus=args.aug_plus,
         num_workers=args.workers,
+        host_rrc=args.host_rrc,
     )
-    parallel = override(cfg.parallel, num_data=args.num_data, num_model=args.num_model)
+    parallel = override(
+        cfg.parallel,
+        num_data=args.num_data,
+        num_model=args.num_model,
+        shard_weight_update=args.shard_weight_update,
+    )
     return override(
         dataclasses.replace(cfg, moco=moco, optim=optim, data=data, parallel=parallel),
         seed=args.seed,
         workdir=args.workdir,
         log_every=args.print_freq,
         steps_per_epoch=args.steps_per_epoch,
+        knn_every_epochs=args.knn_every_epochs,
     )
 
 
