@@ -178,6 +178,14 @@ def create_state(
     the data-axis size — the optimizer state is then initialized in the
     (n, m) sharded-flat layout (moco_tpu/parallel/zero.py) instead of the
     param tree's shapes."""
+    if config.parallel.shard_weight_update and not zero_num_data:
+        # fail here, not downstream: a replicated opt state silently built
+        # for a ZeRO config would later be mis-sharded by the ndim==2
+        # spec heuristic or squeezed into garbage shapes
+        raise ValueError(
+            "config.parallel.shard_weight_update=True requires zero_num_data "
+            "(the data-axis size) so the opt state gets the (n, m) layout"
+        )
     p_rng, q_rng, pred_rng = jax.random.split(rng, 3)
     variables = encoder.init(p_rng, sample_input, train=False)
     params = variables["params"]

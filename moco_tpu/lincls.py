@@ -67,8 +67,13 @@ def load_pretrained_backbone(
     from moco_tpu.utils.schedules import build_optimizer
 
     mgr = CheckpointManager(workdir)
-    if config is None:
+    # extras are needed to discover the config and/or the ZeRO mesh width;
+    # skip the metadata round-trip entirely on the explicit-config,
+    # replicated-opt-state fast path
+    extra: dict = {}
+    if config is None or config.parallel.shard_weight_update:
         extra = mgr.read_extra()
+    if config is None:
         if "config" not in extra:
             raise KeyError(
                 f"checkpoint under {workdir} carries no config — pass one explicitly"
@@ -77,11 +82,24 @@ def load_pretrained_backbone(
     encoder = build_encoder(config.moco)
     predictor = build_predictor(config.moco)
     # the template's opt_state tree must match the saved one exactly, so
-    # build the same optimizer family the pretrain driver used
+    # build the same optimizer family the pretrain driver used — including
+    # the ZeRO layout: shard_weight_update saves (num_data, m) opt-state
+    # leaves, with num_data = the TRAIN-time mesh width from extras (the
+    # config alone may say "all devices")
     tx = build_optimizer(config.optim, steps_per_epoch=1)
+    zero_num_data = None
+    if config.parallel.shard_weight_update:
+        zero_num_data = extra.get("num_data") or config.parallel.num_data
+        if zero_num_data is None:
+            raise ValueError(
+                "ZeRO checkpoint carries no train-time num_data and "
+                "config.parallel.num_data is unset — cannot size the "
+                "opt-state restore template"
+            )
     sample = jnp.zeros((1, config.data.image_size, config.data.image_size, 3), jnp.float32)
     template = create_state(
-        jax.random.PRNGKey(0), config, encoder, tx, sample, predictor=predictor
+        jax.random.PRNGKey(0), config, encoder, tx, sample, predictor=predictor,
+        zero_num_data=zero_num_data,
     )
     state, _ = mgr.restore(template)
     mgr.close()

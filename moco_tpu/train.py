@@ -256,6 +256,12 @@ def train(
                         extra={
                             "epoch": completed_epoch,
                             "config": config_to_dict(config),
+                            # ZeRO opt-state leaves are (num_data, m):
+                            # downstream template builders (lincls,
+                            # convert_pretrain) need the TRAIN-time mesh
+                            # width, which config alone may not pin
+                            # (parallel.num_data=None = "all devices")
+                            "num_data": num_data,
                         },
                     )
                 if stop_now:

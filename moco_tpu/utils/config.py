@@ -229,8 +229,10 @@ PRESETS = {
             momentum_cos=True, temperature=0.2, v3=True, shuffle="none",
             vit_pool="gap", vit_sequence_parallel=True,
         ),
+        # lr follows the v3 rule 1.5e-4 * batch/256 at THIS preset's
+        # batch of 1024 (not the 4096 of vit_b16_v3 above)
         optim=OptimConfig(
-            optimizer="adamw", lr=2.4e-3, weight_decay=0.1, epochs=300,
+            optimizer="adamw", lr=6e-4, weight_decay=0.1, epochs=300,
             cos=True, warmup_epochs=40,
         ),
         data=DataConfig(

@@ -1,0 +1,115 @@
+"""Two-process multi-host worker (spawned by tests/test_multihost.py).
+
+Each worker is a REAL separate process that joins a 2-process
+`jax.distributed` world over the CPU backend (the same rendezvous path a
+TPU pod host takes — `initialize_multihost` wraps
+`jax.distributed.initialize`, the NCCL `init_process_group` equivalent,
+`main_moco.py:~L150`). With a 2-virtual-device CPU platform per process
+the world is a 4-device mesh spanning both processes; the worker then
+runs the full MoCo pretrain step — cross-process shuffle-BN gather-perm,
+queue enqueue, gradient psum — while its input pipeline decodes ONLY the
+global-batch rows its own devices own (DistributedSampler equivalent,
+`main_moco.py:~L258`).
+
+Prints one JSON line of per-process evidence for the parent to compare:
+losses must match bit-for-bit across processes (lockstep replicated
+state) and each process must have decoded exactly half the global batch.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def main() -> None:
+    addr, pid, nproc = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+
+    from moco_tpu.parallel import initialize_multihost
+
+    initialize_multihost(coordinator_address=addr, num_processes=nproc, process_id=pid)
+    assert jax.process_count() == nproc, jax.process_count()
+
+    from moco_tpu.core import build_encoder, create_state, make_train_step, place_state
+    from moco_tpu.data.pipeline import TwoCropPipeline
+    from moco_tpu.parallel import create_mesh
+    from moco_tpu.utils.config import (
+        DataConfig,
+        MocoConfig,
+        OptimConfig,
+        TrainConfig,
+    )
+    from moco_tpu.utils.schedules import build_optimizer
+
+    world = jax.devices()
+    num_data = len(world)
+    mesh = create_mesh(num_data=num_data, num_model=1)
+    batch = 2 * num_data
+    img = 32
+    config = TrainConfig(
+        moco=MocoConfig(
+            arch="resnet18",
+            dim=32,
+            num_negatives=batch * 4,
+            temperature=0.2,
+            mlp=True,
+            shuffle="gather_perm",  # cross-PROCESS permutation collective
+            cifar_stem=True,
+            compute_dtype="float32",
+        ),
+        optim=OptimConfig(lr=0.03, epochs=1, cos=True),
+        data=DataConfig(
+            dataset="synthetic", image_size=img, global_batch=batch, num_workers=2
+        ),
+    )
+
+    pipe = TwoCropPipeline(config.data, mesh, seed=0)
+    part = pipe._partition
+    assert not part.is_trivial, "partition must be non-trivial across 2 processes"
+
+    encoder = build_encoder(config.moco, num_data=num_data)
+    tx = build_optimizer(config.optim, steps_per_epoch=pipe.steps_per_epoch)
+    state = create_state(
+        jax.random.PRNGKey(0), config, encoder, tx, jnp.zeros((1, img, img, 3))
+    )
+    state = place_state(state, mesh)
+    step_fn = make_train_step(config, encoder, tx, mesh)
+    root_rng = jax.device_put(
+        jax.random.PRNGKey(2),
+        jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec()),
+    )
+
+    losses = []
+    for _step, batch_dict in zip(range(2), pipe.epoch(0)):
+        state, metrics = step_fn(state, batch_dict, root_rng)
+        # loss is fully replicated -> addressable from every process
+        losses.append(float(jax.device_get(metrics["loss"])))
+
+    print(
+        json.dumps(
+            {
+                "process": pid,
+                "process_count": jax.process_count(),
+                "world_devices": len(world),
+                "local_devices": len(jax.local_devices()),
+                "local_rows": int(part.local_rows),
+                "global_batch": batch,
+                "local_positions": np.asarray(part.local_positions).tolist(),
+                "losses": losses,
+                "final_step": int(state.step),
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

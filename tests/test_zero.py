@@ -102,7 +102,6 @@ def test_zero_v3_step_runs_and_matches():
 
 def test_zero_opt_state_is_sharded():
     config = _config(zero=True, optimizer="adamw")
-    _, _ = _run_steps(config, n_steps=1)
     # opt state leaves (other than scalars) are (8, m): 1/8 per device
     state, _ = _run_steps(config, n_steps=1)
     leaves = [x for x in jax.tree.leaves(state.opt_state) if x.ndim == 2]
@@ -127,3 +126,29 @@ def test_zero_rejects_lars():
     )
     with pytest.raises(ValueError, match="element-wise"):
         make_train_step(config, encoder, tx, mesh, state_template=state)
+
+
+def test_zero_checkpoint_restores_into_lincls(tmp_path):
+    """A ZeRO-trained checkpoint must restore through the downstream
+    template builders: the driver records the train-time mesh width in
+    extras, and load_pretrained_backbone rebuilds the (num_data, m)
+    opt-state layout from it (regression: it used to build a replicated
+    template and fail the StandardRestore shape match)."""
+    from moco_tpu.data.datasets import SyntheticDataset
+    from moco_tpu.lincls import load_pretrained_backbone
+    from moco_tpu.train import train
+
+    config = _config(zero=True, optimizer="adamw")
+    config = dataclasses.replace(
+        config,
+        optim=dataclasses.replace(config.optim, epochs=1),
+        workdir=str(tmp_path / "pre_zero"),
+        log_every=100,
+    )
+    dataset = SyntheticDataset(num_examples=2 * BATCH, image_size=IMG)
+    train(config, dataset=dataset)
+
+    # config=None: arch/optimizer/ZeRO layout all come from the checkpoint
+    params, stats, cfg = load_pretrained_backbone(config.workdir)
+    assert cfg.parallel.shard_weight_update
+    assert jax.tree.leaves(params)
