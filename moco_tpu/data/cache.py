@@ -1,0 +1,295 @@
+"""Decode-once packed RGB cache for path-based datasets.
+
+The reference hides JPEG-decode cost behind 32 DataLoader worker
+processes per GPU (`main_moco.py:~L256` num_workers); on TPU hosts with
+few cores the decode is the input-pipeline bound (see PROFILE.md /
+bench.py's with-data rate). This cache removes the per-epoch decode
+entirely: every image is decoded ONCE at full original geometry and its
+raw RGB pixels appended to one packed file; epochs then read crops
+straight out of an `np.memmap` — no codec work, no per-image files, and
+the host-crop RandomResizedCrop protocol keeps sampling boxes against
+the ORIGINAL image dims, so the crop distribution stays
+torchvision-exact (the same guarantee the direct JPEG path gives).
+
+Layout under `cache_dir`:
+    data.bin        — concatenated H*W*3 uint8 blobs (original geometry)
+    canvas_{S}.bin  — (N, S, S, 3) uint8 fixed-stride canvases
+                      (shortest-side resize + center crop at S), so the
+                      canvas/on-device-crop input mode (`host_rrc=False`)
+                      is a pure mmap row read — zero host codec AND
+                      resize work per epoch
+    index.npz       — offsets (N+1,) int64, dims (N,2) int32 [h,w],
+                      labels (N,) int32, num_classes
+    .complete       — stamp JSON {n, canvas_sizes, root, fingerprint}
+
+Safety properties:
+- builds take an exclusive fcntl lock (same pattern as the native
+  loader's cross-process build lock) and write per-pid temp names, so
+  concurrent processes sharing a cache_dir cannot interleave writes;
+- the stamp records the SOURCE identity (root path + a fingerprint of
+  the (path, label) listing); reuse against a different source raises
+  instead of silently serving the wrong pixels. Content edited in-place
+  under the same root with identical file names is the one drift this
+  cannot see — delete the cache_dir to force a rebuild;
+- a cache built at one canvas size grows canvases for new sizes on
+  demand from data.bin (no re-decode), so changing image_size never
+  silently drops the mmap fast path.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Callable, Optional, Union
+
+import numpy as np
+
+__all__ = ["PackedRGBCacheDataset", "build_rgb_cache"]
+
+
+def _fingerprint(samples) -> str:
+    h = hashlib.sha256()
+    for path, label in samples:
+        h.update(f"{os.path.basename(path)}\0{label}\n".encode())
+    return f"{len(samples)}:{h.hexdigest()[:16]}"
+
+
+def _read_stamp(cache_dir: str) -> Optional[dict]:
+    try:
+        with open(os.path.join(cache_dir, ".complete")) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _canvas(arr: np.ndarray, size: int) -> np.ndarray:
+    """Shortest-side BILINEAR resize + square center crop — the same
+    canvas ImageFolderDataset.load produces from the decoded image."""
+    from PIL import Image
+
+    h, w, _ = arr.shape
+    s = size / min(w, h)
+    im = Image.fromarray(np.ascontiguousarray(arr)).resize(
+        (max(size, round(w * s)), max(size, round(h * s))),
+        resample=Image.BILINEAR,
+    )
+    out = np.asarray(im, np.uint8)
+    h, w, _ = out.shape
+    y0, x0 = (h - size) // 2, (w - size) // 2
+    return out[y0 : y0 + size, x0 : x0 + size]
+
+
+def build_rgb_cache(
+    source_or_factory: Union[object, Callable[[], object]],
+    cache_dir: str,
+    num_workers: int = 8,
+    canvas_size: int = 256,
+    root: Optional[str] = None,
+) -> str:
+    """Decode every image of a source dataset (anything with `.samples`
+    [(path, label), ...]) at ORIGINAL size into the packed-file layout,
+    plus a fixed-stride canvas file at `canvas_size`.
+
+    `source_or_factory` may be a zero-arg callable so the caller avoids
+    constructing (and directory-scanning) the source when the cache is
+    already complete. `root` is the source's directory: recorded in the
+    stamp on build, verified on reuse so a stale cache from a DIFFERENT
+    source raises instead of silently serving wrong pixels. A complete
+    cache missing `canvas_{canvas_size}.bin` grows it from data.bin
+    without re-decoding. Returns `cache_dir`."""
+    stamp = _read_stamp(cache_dir)
+    root_real = os.path.realpath(root) if root else None
+    if stamp is not None:
+        if root_real and stamp.get("root") and stamp["root"] != root_real:
+            raise ValueError(
+                f"RGB cache at {cache_dir} was built from {stamp['root']!r}, "
+                f"not {root_real!r} — point --cache-dir elsewhere or delete it"
+            )
+        if canvas_size in stamp.get("canvas_sizes", []):
+            return cache_dir
+        _with_build_lock(cache_dir, lambda: _grow_canvas(cache_dir, canvas_size))
+        return cache_dir
+    source = source_or_factory() if callable(source_or_factory) else source_or_factory
+    _with_build_lock(
+        cache_dir,
+        lambda: _build(source, cache_dir, num_workers, canvas_size, root_real),
+    )
+    return cache_dir
+
+
+def _with_build_lock(cache_dir: str, fn) -> None:
+    """Exclusive fcntl lock + post-acquire re-check wrapper (the native
+    loader's build-lock pattern): only one process builds; the rest wait
+    and find the finished artifacts."""
+    import fcntl
+
+    os.makedirs(cache_dir, exist_ok=True)
+    with open(os.path.join(cache_dir, ".build.lock"), "w") as lockf:
+        fcntl.flock(lockf, fcntl.LOCK_EX)
+        try:
+            fn()
+        finally:
+            fcntl.flock(lockf, fcntl.LOCK_UN)
+
+
+def _build(source, cache_dir, num_workers, canvas_size, root_real) -> None:
+    from concurrent.futures import ThreadPoolExecutor
+
+    from PIL import Image
+
+    if _read_stamp(cache_dir) is not None:  # another process built it
+        _grow_canvas(cache_dir, canvas_size)
+        return
+    samples = source.samples
+    n = len(samples)
+
+    def decode(i):
+        path, label = samples[i]
+        try:
+            with Image.open(path) as im:
+                arr = np.asarray(im.convert("RGB"), np.uint8)
+        except Exception:
+            arr = np.zeros((1, 1, 3), np.uint8)  # dead slot, mirrors loaders
+        return arr, int(label)
+
+    offsets = np.zeros(n + 1, np.int64)
+    dims = np.zeros((n, 2), np.int32)
+    labels = np.zeros(n, np.int32)
+    pid = os.getpid()  # per-pid temps: no interleaved writes even unlocked
+    data_tmp = os.path.join(cache_dir, f"data.bin.tmp.{pid}")
+    canvas_tmp = os.path.join(cache_dir, f"canvas_{canvas_size}.bin.tmp.{pid}")
+    with open(data_tmp, "wb") as f, open(canvas_tmp, "wb") as cf, ThreadPoolExecutor(
+        max_workers=max(num_workers, 1)
+    ) as pool:
+        # decode in parallel, write strictly in index order
+        for i, (arr, label) in enumerate(pool.map(decode, range(n))):
+            f.write(arr.tobytes())
+            cf.write(_canvas(arr, canvas_size).tobytes())
+            offsets[i + 1] = offsets[i] + arr.size
+            dims[i] = arr.shape[:2]
+            labels[i] = label
+    np.savez(
+        os.path.join(cache_dir, "index.npz"),
+        offsets=offsets,
+        dims=dims,
+        labels=labels,
+        num_classes=np.int32(getattr(source, "num_classes", int(labels.max()) + 1)),
+    )
+    os.replace(data_tmp, os.path.join(cache_dir, "data.bin"))
+    os.replace(canvas_tmp, os.path.join(cache_dir, f"canvas_{canvas_size}.bin"))
+    with open(os.path.join(cache_dir, ".complete"), "w") as f:
+        json.dump(
+            {
+                "n": n,
+                "canvas_sizes": [canvas_size],
+                "root": root_real,
+                "fingerprint": _fingerprint(samples),
+            },
+            f,
+        )
+
+
+def _grow_canvas(cache_dir: str, canvas_size: int) -> None:
+    """Add canvas_{S}.bin for a new size to a complete cache, resizing
+    from the stored full-geometry pixels (no re-decode)."""
+    stamp = _read_stamp(cache_dir)
+    if stamp is None or canvas_size in stamp.get("canvas_sizes", []):
+        return
+    ds = PackedRGBCacheDataset(cache_dir, decode_size=canvas_size)
+    pid = os.getpid()
+    canvas_tmp = os.path.join(cache_dir, f"canvas_{canvas_size}.bin.tmp.{pid}")
+    with open(canvas_tmp, "wb") as cf:
+        for i in range(len(ds)):
+            cf.write(_canvas(ds._image(i), canvas_size).tobytes())
+    os.replace(canvas_tmp, os.path.join(cache_dir, f"canvas_{canvas_size}.bin"))
+    stamp["canvas_sizes"] = sorted(stamp.get("canvas_sizes", []) + [canvas_size])
+    with open(os.path.join(cache_dir, ".complete"), "w") as f:
+        json.dump(stamp, f)
+
+
+class PackedRGBCacheDataset:
+    """Same duck-typed surface as ImageFolderDataset (load / dims /
+    load_crop_batch / num_classes), reading from the packed cache."""
+
+    def __init__(self, cache_dir: str, decode_size: int = 256):
+        if not os.path.exists(os.path.join(cache_dir, ".complete")):
+            raise FileNotFoundError(f"no complete RGB cache under {cache_dir}")
+        idx = np.load(os.path.join(cache_dir, "index.npz"))
+        self.offsets = idx["offsets"]
+        self._dims = idx["dims"]
+        self.labels = idx["labels"]
+        self.num_classes = int(idx["num_classes"])
+        self.decode_size = decode_size
+        self._data = np.memmap(
+            os.path.join(cache_dir, "data.bin"), dtype=np.uint8, mode="r"
+        )
+        n = len(self.labels)
+        canvas_path = os.path.join(cache_dir, f"canvas_{decode_size}.bin")
+        self._canvases = (
+            np.memmap(canvas_path, dtype=np.uint8, mode="r").reshape(
+                n, decode_size, decode_size, 3
+            )
+            if os.path.exists(canvas_path)
+            else None
+        )
+
+    def __len__(self) -> int:
+        return len(self.labels)
+
+    def _image(self, index: int) -> np.ndarray:
+        h, w = self._dims[index]
+        start = self.offsets[index]
+        return self._data[start : start + h * w * 3].reshape(h, w, 3)
+
+    def load(self, index: int, decode_size: Optional[int] = None) -> tuple[np.ndarray, int]:
+        """Shortest-side resize + square center-crop canvas, matching
+        ImageFolderDataset.load (same BILINEAR semantics) minus the
+        decode. At the cache's own canvas size this is a pure mmap row
+        read — no resize either."""
+        size = decode_size or self.decode_size
+        if self._canvases is not None and size == self._canvases.shape[1]:
+            return np.asarray(self._canvases[index]), int(self.labels[index])
+        return _canvas(self._image(index), size), int(self.labels[index])
+
+    def dims(self, indices) -> np.ndarray:
+        return self._dims[np.asarray(indices, np.int64)]
+
+    def load_crop_batch(
+        self, indices, boxes: np.ndarray, out_size: int, pool=None
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Host-crop protocol against the cached full-geometry pixels:
+        identical output to the JPEG path's decode+crop (same pixels,
+        same PIL BILINEAR resized-crop), at memmap-read cost."""
+        from PIL import Image
+
+        idx = np.asarray(indices, np.int64)
+        boxes = np.asarray(boxes, np.int64)
+        bs, n_crops = boxes.shape[0], boxes.shape[1]
+        out = np.zeros((bs, n_crops, out_size, out_size, 3), np.uint8)
+        labels = np.empty(bs, np.int32)
+
+        def one(row):
+            i = int(idx[row])
+            labels[row] = self.labels[i]
+            arr = self._image(i)
+            h, w, _ = arr.shape
+            for c in range(n_crops):
+                y0, x0, ch, cw = boxes[row, c]
+                y0 = int(np.clip(y0, 0, h - 1))
+                x0 = int(np.clip(x0, 0, w - 1))
+                ch = int(np.clip(ch, 1, h - y0))
+                cw = int(np.clip(cw, 1, w - x0))
+                crop = Image.fromarray(
+                    np.ascontiguousarray(arr[y0 : y0 + ch, x0 : x0 + cw])
+                ).resize((out_size, out_size), resample=Image.BILINEAR)
+                out[row, c] = np.asarray(crop, np.uint8)
+
+        if pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            if not hasattr(self, "_crop_pool"):
+                self._crop_pool = ThreadPoolExecutor(max_workers=8)
+            pool = self._crop_pool
+        list(pool.map(one, range(bs)))
+        return out, labels

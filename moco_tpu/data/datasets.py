@@ -324,6 +324,7 @@ def build_dataset(
     image_size: int,
     train: bool = True,
     num_workers: int = 8,
+    cache_dir: Optional[str] = None,
 ):
     if name == "synthetic":
         return SyntheticDataset(image_size=max(image_size, 32))
@@ -342,6 +343,24 @@ def build_dataset(
             root = os.path.join(data_dir, split)
         # decode canvas ~1.146x the crop (256 for 224-crops, the standard ratio)
         decode_size = round(image_size * 256 / 224)
+        if cache_dir:
+            # decode-once packed RGB cache: built from the plain folder
+            # listing, then all epoch reads come from the mmap. The source
+            # is a FACTORY so a complete cache skips the directory scan
+            # (and tolerates a since-removed data_dir); the root is
+            # recorded/verified so a stale cache from a different source
+            # raises instead of serving wrong pixels.
+            from moco_tpu.data.cache import PackedRGBCacheDataset, build_rgb_cache
+
+            split_cache = os.path.join(cache_dir, "train" if train else "val")
+            build_rgb_cache(
+                lambda: ImageFolderDataset(root, decode_size=decode_size),
+                split_cache,
+                num_workers=num_workers,
+                canvas_size=decode_size,
+                root=root,
+            )
+            return PackedRGBCacheDataset(split_cache, decode_size=decode_size)
         from moco_tpu.data.native_loader import native_available
 
         if native_available():  # C++ decode pool (native/loader.cc)

@@ -93,6 +93,7 @@ class _HostPipeline:
             config.image_size,
             train=train,
             num_workers=config.num_workers,
+            cache_dir=config.cache_dir,
         )
         self.batch_size = config.global_batch
         if drop_last and len(self.dataset) < self.batch_size:

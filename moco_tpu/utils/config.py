@@ -95,6 +95,11 @@ class DataConfig:
     # to datasets exposing the host-crop protocol (imagefolder); others
     # keep the on-device crop from the decode canvas.
     host_rrc: bool = True
+    # Decode-once packed RGB cache (moco_tpu/data/cache.py): build on
+    # first use under this dir, then epochs read raw full-geometry
+    # pixels from an mmap instead of re-decoding JPEGs — the answer to
+    # few-core TPU hosts where codec work bounds the input pipeline.
+    cache_dir: Optional[str] = None
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,161 @@
+"""Decode-once packed RGB cache (moco_tpu/data/cache.py): cached reads
+must be pixel-identical to the direct JPEG path — same `load` canvas,
+same host-crop protocol output, same dims/labels — since the cache
+stores the exact decoded full-geometry RGB. The point of the cache is
+removing per-epoch codec work on few-core TPU hosts (the reference
+leans on 32 DataLoader workers instead, `main_moco.py:~L256`)."""
+
+import os
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from moco_tpu.data.cache import PackedRGBCacheDataset, build_rgb_cache
+from moco_tpu.data.datasets import ImageFolderDataset, build_dataset, sample_rrc_boxes
+
+
+@pytest.fixture(scope="module")
+def jpeg_folder(tmp_path_factory):
+    root = tmp_path_factory.mktemp("imgs")
+    rng = np.random.default_rng(0)
+    # ragged geometries on purpose: wide, tall, tiny
+    shapes = [(48, 64), (64, 48), (40, 40), (80, 56), (56, 80), (36, 52)]
+    for c in range(2):
+        (root / f"class_{c}").mkdir()
+        for i, (h, w) in enumerate(shapes):
+            arr = rng.integers(0, 256, (h, w, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(root / f"class_{c}" / f"im_{i}.jpg", quality=92)
+    return str(root)
+
+
+@pytest.fixture(scope="module")
+def both(jpeg_folder, tmp_path_factory):
+    src = ImageFolderDataset(jpeg_folder, decode_size=32)
+    cache_dir = str(tmp_path_factory.mktemp("cache"))
+    build_rgb_cache(src, cache_dir, num_workers=2, canvas_size=32)
+    return src, PackedRGBCacheDataset(cache_dir, decode_size=32)
+
+
+def test_index_matches_source(both):
+    src, cached = both
+    assert len(cached) == len(src)
+    assert cached.num_classes == src.num_classes
+    idx = np.arange(len(src))
+    np.testing.assert_array_equal(cached.dims(idx), src.dims(idx))
+    for i in idx:
+        assert int(cached.labels[i]) == src.samples[i][1]
+
+
+def test_load_canvas_identical(both):
+    # the fixture's canvas file matches decode_size, so this exercises
+    # the zero-resize mmap row read
+    src, cached = both
+    assert cached._canvases is not None
+    for i in range(len(src)):
+        a, la = src.load(i)
+        b, lb = cached.load(i)
+        np.testing.assert_array_equal(a, b)
+        assert la == lb
+
+
+def test_load_canvas_fallback_resize(both):
+    # a decode_size with no canvas file falls back to resizing the
+    # cached full-geometry pixels — still identical to the JPEG path
+    src, cached = both
+    for i in range(0, len(src), 3):
+        a, _ = src.load(i, decode_size=24)
+        b, _ = cached.load(i, decode_size=24)
+        np.testing.assert_array_equal(a, b)
+
+
+def test_crop_batch_identical(both):
+    src, cached = both
+    idx = np.arange(len(src))
+    rng = np.random.default_rng(7)
+    dims = src.dims(idx)
+    boxes = np.stack(
+        [sample_rrc_boxes(rng, dims, scale=(0.2, 1.0)) for _ in range(2)], axis=1
+    )
+    a_imgs, a_lab = src.load_crop_batch(idx, boxes, out_size=24)
+    b_imgs, b_lab = cached.load_crop_batch(idx, boxes, out_size=24)
+    np.testing.assert_array_equal(a_imgs, b_imgs)
+    np.testing.assert_array_equal(a_lab, b_lab)
+
+
+def test_build_is_idempotent(both, tmp_path):
+    src, cached = both
+    # a second build over an existing complete cache is a no-op
+    marker = os.path.getmtime
+    cache_dir = os.path.dirname(cached._data.filename)
+    t0 = marker(os.path.join(cache_dir, "data.bin"))
+    build_rgb_cache(src, cache_dir)
+    assert marker(os.path.join(cache_dir, "data.bin")) == t0
+
+
+def test_build_dataset_wires_cache(jpeg_folder, tmp_path):
+    ds = build_dataset(
+        "imagefolder", jpeg_folder, image_size=28, cache_dir=str(tmp_path / "c")
+    )
+    assert isinstance(ds, PackedRGBCacheDataset)
+    img, label = ds.load(0)
+    assert img.shape == (32, 32, 3)  # decode canvas = round(28 * 256/224)
+
+
+def test_stale_cache_from_other_source_raises(jpeg_folder, tmp_path):
+    """A cache built from one root must refuse reuse against another
+    (regression: it used to silently serve the wrong pixels/labels)."""
+    cache_dir = str(tmp_path / "c")
+    src = ImageFolderDataset(jpeg_folder, decode_size=32)
+    build_rgb_cache(src, cache_dir, canvas_size=32, root=jpeg_folder)
+
+    other = tmp_path / "other_root" / "class_0"
+    other.mkdir(parents=True)
+    Image.fromarray(np.zeros((40, 40, 3), np.uint8)).save(other / "im.jpg")
+    with pytest.raises(ValueError, match="built from"):
+        build_rgb_cache(
+            lambda: ImageFolderDataset(str(tmp_path / "other_root"), decode_size=32),
+            cache_dir,
+            canvas_size=32,
+            root=str(tmp_path / "other_root"),
+        )
+
+
+def test_complete_cache_skips_source_factory(jpeg_folder, tmp_path):
+    """With a complete cache the source factory is never called — no
+    directory scan, and a removed data_dir is tolerated."""
+    cache_dir = str(tmp_path / "c")
+    build_rgb_cache(
+        ImageFolderDataset(jpeg_folder, decode_size=32),
+        cache_dir,
+        canvas_size=32,
+        root=jpeg_folder,
+    )
+
+    def boom():
+        raise AssertionError("factory called despite complete cache")
+
+    build_rgb_cache(boom, cache_dir, canvas_size=32, root=jpeg_folder)
+
+
+def test_new_canvas_size_grows_without_redecode(jpeg_folder, tmp_path):
+    """Changing image_size against an existing cache must regrow the
+    mmap canvas fast path rather than silently falling back to per-image
+    resizes."""
+    cache_dir = str(tmp_path / "c")
+    src = ImageFolderDataset(jpeg_folder, decode_size=32)
+    build_rgb_cache(src, cache_dir, canvas_size=32, root=jpeg_folder)
+    # same cache, new size — source factory must not be needed
+    build_rgb_cache(
+        lambda: (_ for _ in ()).throw(AssertionError("re-decode attempted")),
+        cache_dir,
+        canvas_size=24,
+        root=jpeg_folder,
+    )
+    ds = PackedRGBCacheDataset(cache_dir, decode_size=24)
+    assert ds._canvases is not None and ds._canvases.shape[1] == 24
+    src24 = ImageFolderDataset(jpeg_folder, decode_size=24)
+    for i in range(0, len(src24), 3):
+        a, _ = src24.load(i)
+        b, _ = ds.load(i)
+        np.testing.assert_array_equal(a, b)

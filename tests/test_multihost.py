@@ -17,6 +17,7 @@ import os
 import socket
 import subprocess
 import sys
+import threading
 
 WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_multihost_worker.py")
 NPROC = 2
@@ -59,10 +60,27 @@ def test_two_process_world_trains_in_lockstep():
         )
         for pid in range(NPROC)
     ]
+    # drain both workers CONCURRENTLY: a full stderr pipe on one worker
+    # mid-collective would block its peer too, and a sequential
+    # communicate() would then read that as a spurious timeout
+    results: dict[int, tuple] = {}
+
+    def drain(i, p):
+        results[i] = p.communicate(timeout=560)
+
     outs = []
     try:
-        for p in procs:
-            out, err = p.communicate(timeout=560)
+        threads = [
+            threading.Thread(target=drain, args=(i, p), daemon=True)
+            for i, p in enumerate(procs)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=580)
+        for i, p in enumerate(procs):
+            assert i in results, f"worker {i} did not complete in time"
+            out, err = results[i]
             assert p.returncode == 0, f"worker failed rc={p.returncode}\n{err[-4000:]}"
             outs.append(json.loads(out.strip().splitlines()[-1]))
     finally:

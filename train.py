@@ -88,6 +88,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="disable host-side exact RandomResizedCrop (fall back to canvas decode + on-device crop)",
     )
     p.add_argument(
+        "--cache-dir", default=None,
+        help="decode-once packed RGB cache dir: build on first use, then "
+        "epochs read raw pixels from an mmap instead of re-decoding JPEGs",
+    )
+    p.add_argument(
         "--knn-every-epochs", type=int, default=None,
         help="periodic frozen-feature kNN monitor (0 = off)",
     )
@@ -149,6 +154,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         aug_plus=args.aug_plus,
         num_workers=args.workers,
         host_rrc=args.host_rrc,
+        cache_dir=args.cache_dir,
     )
     parallel = override(
         cfg.parallel,
