@@ -27,10 +27,12 @@ Safety properties:
   loader's cross-process build lock) and write per-pid temp names, so
   concurrent processes sharing a cache_dir cannot interleave writes;
 - the stamp records the SOURCE identity (root path + a fingerprint of
-  the (path, label) listing); reuse against a different source raises
-  instead of silently serving the wrong pixels. Content edited in-place
-  under the same root with identical file names is the one drift this
-  cannot see — delete the cache_dir to force a rebuild;
+  the (path, label) listing); reuse verifies both, so a cache from a
+  different source, or one whose source gained/lost images or classes,
+  raises instead of silently serving the wrong pixels. (If the source
+  directory is gone the self-contained cache is trusted as-is.) Pixel
+  content edited in-place under identical file names is the one drift
+  this cannot see — delete the cache_dir to force a rebuild;
 - a cache built at one canvas size grows canvases for new sizes on
   demand from data.bin (no re-decode), so changing image_size never
   silently drops the mmap fast path.
@@ -91,13 +93,16 @@ def build_rgb_cache(
     [(path, label), ...]) at ORIGINAL size into the packed-file layout,
     plus a fixed-stride canvas file at `canvas_size`.
 
-    `source_or_factory` may be a zero-arg callable so the caller avoids
-    constructing (and directory-scanning) the source when the cache is
-    already complete. `root` is the source's directory: recorded in the
-    stamp on build, verified on reuse so a stale cache from a DIFFERENT
-    source raises instead of silently serving wrong pixels. A complete
-    cache missing `canvas_{canvas_size}.bin` grows it from data.bin
-    without re-decoding. Returns `cache_dir`."""
+    `source_or_factory` may be a zero-arg callable; on reuse it is still
+    invoked (a directory listing) to verify the stamp's fingerprint, but
+    no pixels are re-decoded — and if construction fails (source
+    directory since removed) the self-contained cache is trusted as-is.
+    `root` is the source's directory, recorded in the stamp on build and
+    checked on reuse. A stale cache — different root, or a listing whose
+    fingerprint drifted (images/classes added or removed) — raises
+    instead of silently serving wrong pixels. A complete cache missing
+    `canvas_{canvas_size}.bin` grows it from data.bin without
+    re-decoding. Returns `cache_dir`."""
     stamp = _read_stamp(cache_dir)
     root_real = os.path.realpath(root) if root else None
     if stamp is not None:
@@ -106,6 +111,19 @@ def build_rgb_cache(
                 f"RGB cache at {cache_dir} was built from {stamp['root']!r}, "
                 f"not {root_real!r} — point --cache-dir elsewhere or delete it"
             )
+        if stamp.get("fingerprint"):
+            try:
+                source = (
+                    source_or_factory() if callable(source_or_factory) else source_or_factory
+                )
+            except Exception:
+                source = None  # source gone: the cache is self-contained
+            if source is not None and _fingerprint(source.samples) != stamp["fingerprint"]:
+                raise ValueError(
+                    f"RGB cache at {cache_dir} is stale: the source listing under "
+                    f"{stamp.get('root') or root_real!r} changed since the build "
+                    "(images or classes added/removed) — delete the cache dir to rebuild"
+                )
         if canvas_size in stamp.get("canvas_sizes", []):
             return cache_dir
         _with_build_lock(cache_dir, lambda: _grow_canvas(cache_dir, canvas_size))
@@ -145,13 +163,15 @@ def _build(source, cache_dir, num_workers, canvas_size, root_real) -> None:
     n = len(samples)
 
     def decode(i):
+        """Decode + canvas-resize in the worker (the consumer thread only
+        writes), returning ready-to-write bytes."""
         path, label = samples[i]
         try:
             with Image.open(path) as im:
                 arr = np.asarray(im.convert("RGB"), np.uint8)
         except Exception:
             arr = np.zeros((1, 1, 3), np.uint8)  # dead slot, mirrors loaders
-        return arr, int(label)
+        return arr.tobytes(), arr.shape[:2], _canvas(arr, canvas_size).tobytes(), int(label)
 
     offsets = np.zeros(n + 1, np.int64)
     dims = np.zeros((n, 2), np.int32)
@@ -159,16 +179,32 @@ def _build(source, cache_dir, num_workers, canvas_size, root_real) -> None:
     pid = os.getpid()  # per-pid temps: no interleaved writes even unlocked
     data_tmp = os.path.join(cache_dir, f"data.bin.tmp.{pid}")
     canvas_tmp = os.path.join(cache_dir, f"canvas_{canvas_size}.bin.tmp.{pid}")
+    workers = max(num_workers, 1)
     with open(data_tmp, "wb") as f, open(canvas_tmp, "wb") as cf, ThreadPoolExecutor(
-        max_workers=max(num_workers, 1)
+        max_workers=workers
     ) as pool:
-        # decode in parallel, write strictly in index order
-        for i, (arr, label) in enumerate(pool.map(decode, range(n))):
-            f.write(arr.tobytes())
-            cf.write(_canvas(arr, canvas_size).tobytes())
-            offsets[i + 1] = offsets[i] + arr.size
-            dims[i] = arr.shape[:2]
+        # bounded submission window (2x workers): plain pool.map would
+        # enqueue all n decodes up front and the finished full-geometry
+        # arrays would accumulate far ahead of the serial writer —
+        # unbounded memory on an ImageNet-scale build
+        from collections import deque
+
+        window: deque = deque()
+        i = 0
+        for j in range(min(2 * workers, n)):
+            window.append(pool.submit(decode, j))
+        next_submit = len(window)
+        while window:
+            raw, hw, canvas_bytes, label = window.popleft().result()
+            if next_submit < n:
+                window.append(pool.submit(decode, next_submit))
+                next_submit += 1
+            f.write(raw)
+            cf.write(canvas_bytes)
+            offsets[i + 1] = offsets[i] + len(raw)
+            dims[i] = hw
             labels[i] = label
+            i += 1
     np.savez(
         os.path.join(cache_dir, "index.npz"),
         offsets=offsets,
@@ -196,7 +232,7 @@ def _grow_canvas(cache_dir: str, canvas_size: int) -> None:
     stamp = _read_stamp(cache_dir)
     if stamp is None or canvas_size in stamp.get("canvas_sizes", []):
         return
-    ds = PackedRGBCacheDataset(cache_dir, decode_size=canvas_size)
+    ds = PackedRGBCacheDataset(cache_dir, decode_size=canvas_size, use_native=False)
     pid = os.getpid()
     canvas_tmp = os.path.join(cache_dir, f"canvas_{canvas_size}.bin.tmp.{pid}")
     with open(canvas_tmp, "wb") as cf:
@@ -210,9 +246,18 @@ def _grow_canvas(cache_dir: str, canvas_size: int) -> None:
 
 class PackedRGBCacheDataset:
     """Same duck-typed surface as ImageFolderDataset (load / dims /
-    load_crop_batch / num_classes), reading from the packed cache."""
+    load_crop_batch / num_classes), reading from the packed cache.
 
-    def __init__(self, cache_dir: str, decode_size: int = 256):
+    `use_native=None` (auto) routes the host-crop protocol through the
+    C++ raw loader when the native library is available — the crop+
+    resize then runs in the C++ worker pool with no codec, GIL, or
+    per-image Python cost. `use_native=False` keeps the PIL resampler
+    (bit-exact with the direct JPEG path; the native resampler agrees
+    only to the documented mean-abs-diff tolerance)."""
+
+    def __init__(
+        self, cache_dir: str, decode_size: int = 256, use_native: Optional[bool] = None
+    ):
         if not os.path.exists(os.path.join(cache_dir, ".complete")):
             raise FileNotFoundError(f"no complete RGB cache under {cache_dir}")
         idx = np.load(os.path.join(cache_dir, "index.npz"))
@@ -224,6 +269,21 @@ class PackedRGBCacheDataset:
         self._data = np.memmap(
             os.path.join(cache_dir, "data.bin"), dtype=np.uint8, mode="r"
         )
+        self._native = None
+        if use_native is not False:
+            try:
+                from moco_tpu.data.native_loader import NativeRawBatchLoader
+
+                self._native = NativeRawBatchLoader(
+                    os.path.join(cache_dir, "data.bin"),
+                    self.offsets,
+                    self._dims,
+                    canvas=decode_size,
+                )
+            except Exception:
+                if use_native:  # explicit request must not degrade silently
+                    raise
+                self._native = None
         n = len(self.labels)
         canvas_path = os.path.join(cache_dir, f"canvas_{decode_size}.bin")
         self._canvases = (
@@ -259,9 +319,14 @@ class PackedRGBCacheDataset:
         self, indices, boxes: np.ndarray, out_size: int, pool=None
     ) -> tuple[np.ndarray, np.ndarray]:
         """Host-crop protocol against the cached full-geometry pixels:
-        identical output to the JPEG path's decode+crop (same pixels,
-        same PIL BILINEAR resized-crop), at memmap-read cost."""
+        same pixels as the JPEG path's decode+crop, at memmap-read cost.
+        Routed through the C++ raw loader when available (thread-pool
+        crop+resize, no GIL); PIL otherwise."""
         from PIL import Image
+
+        if self._native is not None:
+            out = self._native.load_crops(indices, boxes, out_size)
+            return out, np.asarray(self.labels[np.asarray(indices, np.int64)], np.int32)
 
         idx = np.asarray(indices, np.int64)
         boxes = np.asarray(boxes, np.int64)

@@ -32,7 +32,7 @@ _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(__fil
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libmoco_loader.so")
 _build_lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
-ABI_VERSION = 3
+ABI_VERSION = 4
 
 
 def _build_locked() -> None:
@@ -93,6 +93,15 @@ def _declare_bindings(lib: ctypes.CDLL) -> None:
         ctypes.c_int,
         ctypes.POINTER(ctypes.c_int32),
         ctypes.POINTER(ctypes.c_uint8),
+    ]
+    lib.mtl_create_raw.restype = ctypes.c_void_p
+    lib.mtl_create_raw.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int64,
+        ctypes.c_int,
+        ctypes.c_int,
     ]
     lib.mtl_destroy.argtypes = [ctypes.c_void_p]
 
@@ -278,6 +287,97 @@ class NativeBatchLoader:
                     f"native loader: {hard_failures}/{bs} images failed to decode"
                 )
         return out
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle:
+            self._lib.mtl_destroy(handle)
+            self._handle = None
+
+
+class NativeRawBatchLoader:
+    """C++ loader over a packed-RGB cache file (moco_tpu/data/cache.py):
+    the codec stage disappears (samples are raw blobs mmap'd in C++) and
+    the antialiased crop+resize runs in the C++ worker pool instead of
+    PIL — no GIL, no per-image Python. Same load_crops/load_batch/
+    get_dims surface as NativeBatchLoader; raw reads cannot soft-fail,
+    so there is no PIL fallback (dead build slots stay zero, like the
+    path backend's doubly-failed slots)."""
+
+    def __init__(
+        self,
+        data_path: str,
+        offsets: np.ndarray,
+        dims: np.ndarray,
+        canvas: int,
+        threads: int = 8,
+    ):
+        self._lib = _load_lib()
+        offsets = np.ascontiguousarray(offsets, np.int64)
+        dims = np.ascontiguousarray(dims, np.int32)
+        n = len(dims)
+        assert len(offsets) == n + 1
+        # mtl_create_raw copies both arrays into C++ vectors at create
+        self._handle = self._lib.mtl_create_raw(
+            data_path.encode(),
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            dims.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            n,
+            canvas,
+            threads,
+        )
+        if not self._handle:
+            raise RuntimeError(f"mtl_create_raw failed for {data_path}")
+        self.canvas = canvas
+
+    def load_batch(self, indices: np.ndarray) -> np.ndarray:
+        idx = np.ascontiguousarray(indices, dtype=np.int64)
+        out = np.empty((len(idx), self.canvas, self.canvas, 3), np.uint8)
+        status = np.empty(len(idx), np.uint8)
+        errors = self._lib.mtl_load_batch(
+            self._handle,
+            idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(idx),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            status.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        )
+        self._check(errors, status, idx)
+        return out
+
+    def load_crops(
+        self, indices: np.ndarray, boxes: np.ndarray, out_size: int
+    ) -> np.ndarray:
+        idx = np.ascontiguousarray(indices, dtype=np.int64)
+        boxes = np.ascontiguousarray(boxes, dtype=np.int32)
+        bs, n_crops = boxes.shape[0], boxes.shape[1]
+        assert bs == len(idx) and boxes.shape[2] == 4
+        out = np.empty((bs, n_crops, out_size, out_size, 3), np.uint8)
+        status = np.empty(bs, np.uint8)
+        errors = self._lib.mtl_load_batch_crops(
+            self._handle,
+            idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            bs,
+            boxes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            n_crops,
+            out_size,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            status.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        )
+        self._check(errors, status, idx)
+        return out
+
+    def _check(self, errors: int, status: np.ndarray, idx: np.ndarray) -> None:
+        """Raw blob reads cannot soft-fail like codec decodes can — a
+        failed slot means the cache index is inconsistent with data.bin.
+        Training on silently zero-filled slots would be much worse than
+        stopping, so raise."""
+        if errors:
+            bad = idx[np.nonzero(status == 0)[0]].tolist()
+            raise RuntimeError(
+                f"raw cache read failed for indices {bad[:8]}{'...' if len(bad) > 8 else ''} "
+                "— the packed cache is corrupt or its index mismatches data.bin; "
+                "delete the cache dir to rebuild"
+            )
 
     def __del__(self):
         handle = getattr(self, "_handle", None)

@@ -132,12 +132,16 @@ def train(
     if config.knn_every_epochs and knn_pair is None:
         from moco_tpu.data.datasets import build_dataset
 
+        # same cache as the train pipeline: without it every monitor
+        # epoch would re-decode the full dataset through the JPEG path
         knn_pair = (
             build_dataset(
-                config.data.dataset, config.data.data_dir, config.data.image_size, train=True
+                config.data.dataset, config.data.data_dir, config.data.image_size,
+                train=True, cache_dir=config.data.cache_dir,
             ),
             build_dataset(
-                config.data.dataset, config.data.data_dir, config.data.image_size, train=False
+                config.data.dataset, config.data.data_dir, config.data.image_size,
+                train=False, cache_dir=config.data.cache_dir,
             ),
         )
 

@@ -31,10 +31,13 @@ def jpeg_folder(tmp_path_factory):
 
 @pytest.fixture(scope="module")
 def both(jpeg_folder, tmp_path_factory):
+    # use_native=False: these tests assert BIT-exact equality with the
+    # PIL path; the native resampler has its own tolerance-based parity
+    # test below
     src = ImageFolderDataset(jpeg_folder, decode_size=32)
     cache_dir = str(tmp_path_factory.mktemp("cache"))
     build_rgb_cache(src, cache_dir, num_workers=2, canvas_size=32)
-    return src, PackedRGBCacheDataset(cache_dir, decode_size=32)
+    return src, PackedRGBCacheDataset(cache_dir, decode_size=32, use_native=False)
 
 
 def test_index_matches_source(both):
@@ -121,9 +124,10 @@ def test_stale_cache_from_other_source_raises(jpeg_folder, tmp_path):
         )
 
 
-def test_complete_cache_skips_source_factory(jpeg_folder, tmp_path):
-    """With a complete cache the source factory is never called — no
-    directory scan, and a removed data_dir is tolerated."""
+def test_complete_cache_tolerates_missing_source(jpeg_folder, tmp_path):
+    """Reuse verifies the source fingerprint when the source is listable,
+    but a since-removed data_dir must be tolerated — the cache is
+    self-contained."""
     cache_dir = str(tmp_path / "c")
     build_rgb_cache(
         ImageFolderDataset(jpeg_folder, decode_size=32),
@@ -132,10 +136,35 @@ def test_complete_cache_skips_source_factory(jpeg_folder, tmp_path):
         root=jpeg_folder,
     )
 
-    def boom():
-        raise AssertionError("factory called despite complete cache")
+    def gone():
+        raise FileNotFoundError("data_dir was deleted after caching")
 
-    build_rgb_cache(boom, cache_dir, canvas_size=32, root=jpeg_folder)
+    build_rgb_cache(gone, cache_dir, canvas_size=32, root=jpeg_folder)
+    assert len(PackedRGBCacheDataset(cache_dir, decode_size=32, use_native=False)) == 12
+
+
+def test_changed_listing_under_same_root_raises(jpeg_folder, tmp_path):
+    """Images added under the SAME root must invalidate the cache
+    (fingerprint drift), not silently train on the stale subset."""
+    import shutil
+
+    root = str(tmp_path / "root_copy")
+    shutil.copytree(jpeg_folder, root)
+    cache_dir = str(tmp_path / "c")
+    build_rgb_cache(
+        ImageFolderDataset(root, decode_size=32), cache_dir, canvas_size=32, root=root
+    )
+    # grow the dataset in place
+    Image.fromarray(np.zeros((40, 40, 3), np.uint8)).save(
+        os.path.join(root, "class_0", "new_im.jpg")
+    )
+    with pytest.raises(ValueError, match="stale"):
+        build_rgb_cache(
+            lambda: ImageFolderDataset(root, decode_size=32),
+            cache_dir,
+            canvas_size=32,
+            root=root,
+        )
 
 
 def test_new_canvas_size_grows_without_redecode(jpeg_folder, tmp_path):
@@ -159,3 +188,34 @@ def test_new_canvas_size_grows_without_redecode(jpeg_folder, tmp_path):
         a, _ = src24.load(i)
         b, _ = ds.load(i)
         np.testing.assert_array_equal(a, b)
+
+
+def test_native_raw_crop_parity(both, tmp_path):
+    """The C++ raw-cache loader must agree with the PIL resampler to the
+    same tolerance as the path-backed native loader (resamplers differ
+    slightly; dims/labels are exact)."""
+    from moco_tpu.data.native_loader import native_available
+
+    if not native_available():
+        pytest.skip("native loader unavailable")
+    src, cached_pil = both
+    cache_dir = os.path.dirname(cached_pil._data.filename)
+    nat = PackedRGBCacheDataset(cache_dir, decode_size=32, use_native=True)
+    assert nat._native is not None
+
+    idx = np.arange(len(src))
+    np.testing.assert_array_equal(nat.dims(idx), src.dims(idx))
+    rng = np.random.default_rng(11)
+    dims = src.dims(idx)
+    boxes = np.stack(
+        [sample_rrc_boxes(rng, dims, scale=(0.2, 1.0)) for _ in range(2)], axis=1
+    )
+    a_imgs, a_lab = cached_pil.load_crop_batch(idx, boxes, out_size=24)
+    b_imgs, b_lab = nat.load_crop_batch(idx, boxes, out_size=24)
+    np.testing.assert_array_equal(a_lab, b_lab)
+    for i in range(len(idx)):
+        for c in range(2):
+            diff = np.abs(
+                a_imgs[i, c].astype(np.float32) - b_imgs[i, c].astype(np.float32)
+            ).mean()
+            assert diff < 6.0, f"img {i} crop {c}: mean abs diff {diff}"
