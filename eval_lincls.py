@@ -33,6 +33,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--image-size", type=int, default=None)
     p.add_argument("--batch-size", "-b", type=int, default=None)
     p.add_argument("--workers", "-j", type=int, default=None)
+    p.add_argument(
+        "--cache-dir", default=None,
+        help="decode-once packed RGB cache (see train.py --cache-dir); "
+        "defaults to the pretrain checkpoint's setting",
+    )
     p.add_argument("--workdir", default=None)
     return p
 
@@ -69,6 +74,7 @@ def main() -> None:
             "image_size": args.image_size,
             "global_batch": args.batch_size,
             "num_workers": args.workers,
+            "cache_dir": args.cache_dir,
         }.items()
         if v is not None
     }

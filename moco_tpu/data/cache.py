@@ -116,8 +116,12 @@ def build_rgb_cache(
                 source = (
                     source_or_factory() if callable(source_or_factory) else source_or_factory
                 )
-            except Exception:
-                source = None  # source gone: the cache is self-contained
+            except OSError:
+                # source DIRECTORY gone: the cache is self-contained.
+                # Anything else (e.g. "no images under root" — a directory
+                # that exists but lost its images) must propagate: that IS
+                # the drift the fingerprint check exists to catch.
+                source = None
             if source is not None and _fingerprint(source.samples) != stamp["fingerprint"]:
                 raise ValueError(
                     f"RGB cache at {cache_dir} is stale: the source listing under "
@@ -256,7 +260,11 @@ class PackedRGBCacheDataset:
     only to the documented mean-abs-diff tolerance)."""
 
     def __init__(
-        self, cache_dir: str, decode_size: int = 256, use_native: Optional[bool] = None
+        self,
+        cache_dir: str,
+        decode_size: int = 256,
+        use_native: Optional[bool] = None,
+        num_workers: int = 8,
     ):
         if not os.path.exists(os.path.join(cache_dir, ".complete")):
             raise FileNotFoundError(f"no complete RGB cache under {cache_dir}")
@@ -279,6 +287,7 @@ class PackedRGBCacheDataset:
                     self.offsets,
                     self._dims,
                     canvas=decode_size,
+                    threads=max(num_workers, 1),
                 )
             except Exception:
                 if use_native:  # explicit request must not degrade silently

@@ -345,14 +345,17 @@ def build_dataset(
         decode_size = round(image_size * 256 / 224)
         if cache_dir:
             # decode-once packed RGB cache: built from the plain folder
-            # listing, then all epoch reads come from the mmap. The source
-            # is a FACTORY so a complete cache skips the directory scan
-            # (and tolerates a since-removed data_dir); the root is
-            # recorded/verified so a stale cache from a different source
-            # raises instead of serving wrong pixels.
+            # listing, then all epoch reads come from the mmap. Reuse
+            # re-lists the source to verify the stamped fingerprint (a
+            # drifted listing raises; a since-REMOVED data_dir is
+            # tolerated — the cache is self-contained).
             from moco_tpu.data.cache import PackedRGBCacheDataset, build_rgb_cache
 
-            split_cache = os.path.join(cache_dir, "train" if train else "val")
+            # key the cache subdir by the RESOLVED root: a flat data_dir
+            # (no train/ val/ subdirs) serves both splits from one cache
+            # instead of building two identical copies
+            split = ("train" if train else "val") if root != data_dir else "all"
+            split_cache = os.path.join(cache_dir, split)
             build_rgb_cache(
                 lambda: ImageFolderDataset(root, decode_size=decode_size),
                 split_cache,
@@ -360,7 +363,9 @@ def build_dataset(
                 canvas_size=decode_size,
                 root=root,
             )
-            return PackedRGBCacheDataset(split_cache, decode_size=decode_size)
+            return PackedRGBCacheDataset(
+                split_cache, decode_size=decode_size, num_workers=num_workers
+            )
         from moco_tpu.data.native_loader import native_available
 
         if native_available():  # C++ decode pool (native/loader.cc)

@@ -329,6 +329,10 @@ class NativeRawBatchLoader:
         if not self._handle:
             raise RuntimeError(f"mtl_create_raw failed for {data_path}")
         self.canvas = canvas
+        self._dims = dims  # (n, 2) int32, answers get_dims without C++
+
+    def get_dims(self, indices: np.ndarray) -> np.ndarray:
+        return self._dims[np.asarray(indices, np.int64)]
 
     def load_batch(self, indices: np.ndarray) -> np.ndarray:
         idx = np.ascontiguousarray(indices, dtype=np.int64)

@@ -556,8 +556,10 @@ class Loader {
                       uint8_t* dst) {
     Image img;
     if (!fetch_image(idx, &img)) return false;
-    {
-      // opportunistically fill the dims cache (a later get_dims is free)
+    if (!raw_base_) {
+      // opportunistically fill the dims cache (a later get_dims is
+      // free); raw mode answers dims from its table — filling here
+      // would only add hot-path lock traffic and unbounded map growth
       std::lock_guard<std::mutex> lk(dims_mu_);
       dims_cache_[idx] = {img.h, img.w};
     }

@@ -174,13 +174,16 @@ def test_new_canvas_size_grows_without_redecode(jpeg_folder, tmp_path):
     cache_dir = str(tmp_path / "c")
     src = ImageFolderDataset(jpeg_folder, decode_size=32)
     build_rgb_cache(src, cache_dir, canvas_size=32, root=jpeg_folder)
-    # same cache, new size — source factory must not be needed
+    # same cache, new size: canvas grows from the stored pixels — the
+    # packed data file must not be rewritten (no re-decode)
+    t0 = os.path.getmtime(os.path.join(cache_dir, "data.bin"))
     build_rgb_cache(
-        lambda: (_ for _ in ()).throw(AssertionError("re-decode attempted")),
+        lambda: ImageFolderDataset(jpeg_folder, decode_size=24),
         cache_dir,
         canvas_size=24,
         root=jpeg_folder,
     )
+    assert os.path.getmtime(os.path.join(cache_dir, "data.bin")) == t0
     ds = PackedRGBCacheDataset(cache_dir, decode_size=24)
     assert ds._canvases is not None and ds._canvases.shape[1] == 24
     src24 = ImageFolderDataset(jpeg_folder, decode_size=24)
@@ -219,3 +222,18 @@ def test_native_raw_crop_parity(both, tmp_path):
                 a_imgs[i, c].astype(np.float32) - b_imgs[i, c].astype(np.float32)
             ).mean()
             assert diff < 6.0, f"img {i} crop {c}: mean abs diff {diff}"
+
+
+def test_flat_data_dir_shares_one_cache(jpeg_folder, tmp_path):
+    """A data_dir with no train/ val/ subdirs resolves both splits to the
+    same root — they must share ONE cache ('all'), not build two full
+    copies of the same pixels."""
+    cache_dir = str(tmp_path / "c")
+    tr = build_dataset("imagefolder", jpeg_folder, image_size=28, cache_dir=cache_dir)
+    ev = build_dataset(
+        "imagefolder", jpeg_folder, image_size=28, train=False, cache_dir=cache_dir
+    )
+    assert os.path.isdir(os.path.join(cache_dir, "all"))
+    assert not os.path.isdir(os.path.join(cache_dir, "train"))
+    assert not os.path.isdir(os.path.join(cache_dir, "val"))
+    assert len(tr) == len(ev)
