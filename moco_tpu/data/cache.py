@@ -106,7 +106,16 @@ def build_rgb_cache(
     stamp = _read_stamp(cache_dir)
     root_real = os.path.realpath(root) if root else None
     if stamp is not None:
-        if root_real and stamp.get("root") and stamp["root"] != root_real:
+        # mismatch only matters when the REQUESTED root actually exists:
+        # with the source gone, split detection upstream degrades to a
+        # different root string, and the self-contained cache must still
+        # be usable
+        if (
+            root_real
+            and stamp.get("root")
+            and stamp["root"] != root_real
+            and os.path.isdir(root_real)
+        ):
             raise ValueError(
                 f"RGB cache at {cache_dir} was built from {stamp['root']!r}, "
                 f"not {root_real!r} — point --cache-dir elsewhere or delete it"
@@ -274,6 +283,7 @@ class PackedRGBCacheDataset:
         self.labels = idx["labels"]
         self.num_classes = int(idx["num_classes"])
         self.decode_size = decode_size
+        self._num_workers = max(num_workers, 1)
         self._data = np.memmap(
             os.path.join(cache_dir, "data.bin"), dtype=np.uint8, mode="r"
         )
@@ -363,7 +373,7 @@ class PackedRGBCacheDataset:
             from concurrent.futures import ThreadPoolExecutor
 
             if not hasattr(self, "_crop_pool"):
-                self._crop_pool = ThreadPoolExecutor(max_workers=8)
+                self._crop_pool = ThreadPoolExecutor(max_workers=self._num_workers)
             pool = self._crop_pool
         list(pool.map(one, range(bs)))
         return out, labels

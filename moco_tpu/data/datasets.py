@@ -353,8 +353,23 @@ def build_dataset(
 
             # key the cache subdir by the RESOLVED root: a flat data_dir
             # (no train/ val/ subdirs) serves both splits from one cache
-            # instead of building two identical copies
-            split = ("train" if train else "val") if root != data_dir else "all"
+            # ("all") instead of building two identical copies. Existing
+            # caches win over the naming rule: a legacy flat-layout cache
+            # under train/ (or val/) is reused rather than re-decoded, and
+            # when the source directory is GONE the split detection above
+            # degrades (isdir false -> root==data_dir) — the surviving
+            # stamped cache from the original layout is still found.
+            from moco_tpu.data.cache import _read_stamp
+
+            primary = ("train" if train else "val") if root != data_dir else "all"
+            split = primary
+            for cand in dict.fromkeys([primary, "train" if train else "val", "all"]):
+                stamp = _read_stamp(os.path.join(cache_dir, cand))
+                if stamp and (
+                    not os.path.isdir(root) or stamp.get("root") in (None, os.path.realpath(root))
+                ):
+                    split = cand
+                    break
             split_cache = os.path.join(cache_dir, split)
             build_rgb_cache(
                 lambda: ImageFolderDataset(root, decode_size=decode_size),

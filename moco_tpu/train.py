@@ -137,11 +137,13 @@ def train(
         knn_pair = (
             build_dataset(
                 config.data.dataset, config.data.data_dir, config.data.image_size,
-                train=True, cache_dir=config.data.cache_dir,
+                train=True, num_workers=config.data.num_workers,
+                cache_dir=config.data.cache_dir,
             ),
             build_dataset(
                 config.data.dataset, config.data.data_dir, config.data.image_size,
-                train=False, cache_dir=config.data.cache_dir,
+                train=False, num_workers=config.data.num_workers,
+                cache_dir=config.data.cache_dir,
             ),
         )
 

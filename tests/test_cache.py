@@ -237,3 +237,52 @@ def test_flat_data_dir_shares_one_cache(jpeg_folder, tmp_path):
     assert not os.path.isdir(os.path.join(cache_dir, "train"))
     assert not os.path.isdir(os.path.join(cache_dir, "val"))
     assert len(tr) == len(ev)
+
+
+def test_legacy_flat_cache_reused_not_orphaned(jpeg_folder, tmp_path):
+    """A flat-layout cache built under the pre-'all' naming (train/) must
+    be reused by the selector, not orphaned by a silent full re-decode
+    into all/."""
+    cache_dir = str(tmp_path / "c")
+    # simulate the legacy layout: flat root cached under 'train'
+    build_rgb_cache(
+        ImageFolderDataset(jpeg_folder, decode_size=32),
+        os.path.join(cache_dir, "train"),
+        canvas_size=32,
+        root=jpeg_folder,
+    )
+    ds = build_dataset("imagefolder", jpeg_folder, image_size=28, cache_dir=cache_dir)
+    assert isinstance(ds, PackedRGBCacheDataset)
+    assert not os.path.isdir(os.path.join(cache_dir, "all"))  # no re-decode
+    assert "train" in ds._data.filename
+
+
+def test_gone_source_with_split_layout_still_served(tmp_path):
+    """data_dir with train/ val/ subdirs is deleted after caching: split
+    detection degrades, but the surviving stamped cache must be found and
+    trusted (the cache is self-contained)."""
+    import shutil
+
+    rng = np.random.default_rng(3)
+    data_dir = tmp_path / "data"
+    for split in ("train", "val"):
+        d = data_dir / split / "class_0"
+        d.mkdir(parents=True)
+        for i in range(3):
+            arr = rng.integers(0, 256, (40, 44, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(d / f"im_{i}.jpg", quality=92)
+    cache_dir = str(tmp_path / "c")
+    ds1 = build_dataset(
+        "imagefolder", str(data_dir), image_size=28, cache_dir=cache_dir
+    )
+    n = len(ds1)
+    shutil.rmtree(data_dir)
+    ds2 = build_dataset(
+        "imagefolder", str(data_dir), image_size=28, cache_dir=cache_dir
+    )
+    assert isinstance(ds2, PackedRGBCacheDataset)
+    assert len(ds2) == n
+    a, la = ds1.load(0)
+    b, lb = ds2.load(0)
+    np.testing.assert_array_equal(a, b)
+    assert la == lb
