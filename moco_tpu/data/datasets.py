@@ -361,13 +361,32 @@ def build_dataset(
             # stamped cache from the original layout is still found.
             from moco_tpu.data.cache import _read_stamp
 
-            primary = ("train" if train else "val") if root != data_dir else "all"
+            flat = root == data_dir
+            primary = "all" if flat else ("train" if train else "val")
+            # flat layout: both splits are the same data, so ANY stamped
+            # subdir whose root matches serves (legacy caches included).
+            # split layout: only this split's subdir or "all" may serve —
+            # the other split is different data.
+            candidates = ["all", "train", "val"] if flat else [primary, "all"]
             split = primary
-            for cand in dict.fromkeys([primary, "train" if train else "val", "all"]):
+            for cand in dict.fromkeys(candidates):
                 stamp = _read_stamp(os.path.join(cache_dir, cand))
-                if stamp and (
-                    not os.path.isdir(root) or stamp.get("root") in (None, os.path.realpath(root))
-                ):
+                if not stamp:
+                    continue
+                if stamp.get("root") in (None, os.path.realpath(root)):
+                    split = cand
+                    break
+                if not os.path.isdir(root):
+                    # can't distinguish "source deleted after caching"
+                    # from a typo'd --data-dir: serve the self-contained
+                    # cache but say so loudly
+                    import warnings
+
+                    warnings.warn(
+                        f"data_dir {root!r} does not exist; serving RGB cache "
+                        f"{cand!r} built from {stamp.get('root')!r} — if this is "
+                        "a mistyped --data-dir, fix it"
+                    )
                     split = cand
                     break
             split_cache = os.path.join(cache_dir, split)

@@ -81,7 +81,9 @@ def train(
     # Checkpoint ids are the GLOBAL STEP (unique and monotonic even for
     # mid-epoch preemption saves); the epoch lives in extras. Save
     # frequency is gated here in the driver, not by Orbax's policy.
-    ckpt = CheckpointManager(config.workdir, keep=3, save_interval=1)
+    ckpt = CheckpointManager(
+        config.workdir, keep=3, save_interval=1, async_save=config.checkpoint_async
+    )
     start_epoch = 0
     if ckpt.latest_step() is not None:  # --resume semantics, automatic
         state, extra = ckpt.restore(state)
@@ -271,6 +273,7 @@ def train(
                         },
                     )
                 if stop_now:
+                    ckpt.wait()  # the preemption save must be durable before exit
                     print(
                         f"preempted mid-epoch {epoch}: state saved at step "
                         f"{int(state.step)}; resume will redo epoch {epoch}"

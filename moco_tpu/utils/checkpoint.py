@@ -29,8 +29,21 @@ class CheckpointManager:
     """Thin wrapper over `orbax.checkpoint.CheckpointManager` that
     checkpoints an arbitrary state pytree keyed by step/epoch."""
 
-    def __init__(self, directory: str, keep: int = 3, save_interval: int = 1):
+    def __init__(
+        self,
+        directory: str,
+        keep: int = 3,
+        save_interval: int = 1,
+        async_save: bool = False,
+    ):
+        """`async_save=True` overlaps checkpoint writes with subsequent
+        train steps (Orbax async): `save()` returns once the on-device
+        state is snapshotted to host memory; the serialization/write
+        happens on a background thread. `restore`/`latest_step`/`close`
+        all wait for in-flight saves first, and the driver's preemption
+        save must call `wait()` before exiting."""
         self.directory = os.path.abspath(directory)
+        self.async_save = async_save
         os.makedirs(self.directory, exist_ok=True)
         self._mgr = ocp.CheckpointManager(
             self.directory,
@@ -38,14 +51,15 @@ class CheckpointManager:
                 max_to_keep=keep,
                 save_interval_steps=save_interval,
                 create=True,
-                enable_async_checkpointing=False,
+                enable_async_checkpointing=async_save,
             ),
         )
 
     def save(self, step: int, state: Any, extra: Optional[dict] = None, force: bool = False) -> None:
-        """Blocking save of the state pytree + JSON-serializable extras.
-        `force=True` bypasses the save-interval policy (used for the final
-        epoch, which an interval of N would otherwise silently skip)."""
+        """Save of the state pytree + JSON-serializable extras — blocking
+        by default, overlapped when async_save. `force=True` bypasses the
+        save-interval policy (used for the final epoch, which an interval
+        of N would otherwise silently skip)."""
         extra = _jsonify(extra or {})
         self._mgr.save(
             step,
@@ -54,15 +68,22 @@ class CheckpointManager:
             ),
             force=force,
         )
+        if not self.async_save:
+            self._mgr.wait_until_finished()
+
+    def wait(self) -> None:
+        """Block until any in-flight async save is durable."""
         self._mgr.wait_until_finished()
 
     def latest_step(self) -> Optional[int]:
+        self._mgr.wait_until_finished()  # async saves land before counting
         return self._mgr.latest_step()
 
     def read_extra(self, step: Optional[int] = None) -> dict:
         """Restore only the JSON extras (no state template needed) — lets
         tools discover the training config before building a restore
         template."""
+        self._mgr.wait_until_finished()  # async saves land before reading
         step = step if step is not None else self._mgr.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {self.directory}")
@@ -76,6 +97,7 @@ class CheckpointManager:
         its shape/dtype/sharding guide the restore, exactly the
         `load_state_dict` pattern of the reference's `--resume`.
         """
+        self._mgr.wait_until_finished()  # an in-flight async save must land first
         step = step if step is not None else self._mgr.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {self.directory}")

@@ -137,6 +137,10 @@ class TrainConfig:
     workdir: str = "/tmp/moco_tpu"
     log_every: int = 10  # --print-freq
     checkpoint_every_epochs: int = 1
+    # Overlap checkpoint serialization with training (Orbax async): the
+    # save returns after the host snapshot; the write happens on a
+    # background thread. The preemption path always waits for durability.
+    checkpoint_async: bool = False
     steps_per_epoch: Optional[int] = None  # None = derive from dataset size
     # Periodic weighted-kNN monitor on frozen backbone features (the
     # cheap probe proxy the reference lacks — moco_tpu/knn.py): run every
@@ -173,7 +177,10 @@ def config_from_dict(d: dict) -> TrainConfig:
         parallel=build(ParallelConfig, d.get("parallel", {})),
         **{
             k: d[k]
-            for k in ("seed", "workdir", "log_every", "checkpoint_every_epochs", "steps_per_epoch")
+            for k in (
+                "seed", "workdir", "log_every", "checkpoint_every_epochs",
+                "checkpoint_async", "steps_per_epoch",
+            )
             if k in d
         },
     )

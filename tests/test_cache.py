@@ -286,3 +286,21 @@ def test_gone_source_with_split_layout_still_served(tmp_path):
     b, lb = ds2.load(0)
     np.testing.assert_array_equal(a, b)
     assert la == lb
+
+
+def test_legacy_flat_cache_serves_val_split_too(jpeg_folder, tmp_path):
+    """Flat layout: BOTH splits must reuse a legacy train/ cache — the
+    val-split request must not re-decode into all/."""
+    cache_dir = str(tmp_path / "c")
+    build_rgb_cache(
+        ImageFolderDataset(jpeg_folder, decode_size=32),
+        os.path.join(cache_dir, "train"),
+        canvas_size=32,
+        root=jpeg_folder,
+    )
+    ev = build_dataset(
+        "imagefolder", jpeg_folder, image_size=28, train=False, cache_dir=cache_dir
+    )
+    assert isinstance(ev, PackedRGBCacheDataset)
+    assert not os.path.isdir(os.path.join(cache_dir, "all"))
+    assert "train" in ev._data.filename

@@ -74,3 +74,50 @@ def test_best_snapshot(tmp_path, small_state):
     restored, metric = restore_best(str(tmp_path), small_state)
     _assert_trees_equal(small_state, restored)
     assert metric == 61.25
+
+
+def test_async_save_roundtrips_and_waits(tmp_path, small_state):
+    """Async saves overlap with training; restore/wait must first land
+    any in-flight write, and the round-trip is bit-identical."""
+    mgr = CheckpointManager(str(tmp_path / "async"), async_save=True)
+    mgr.save(1, small_state, extra={"epoch": 0})
+    mgr.save(2, small_state, extra={"epoch": 1}, force=True)
+    mgr.wait()
+    restored, extra = mgr.restore(small_state)
+    assert extra["epoch"] == 1
+    _assert_trees_equal(restored, small_state)
+    # restore without an explicit wait must also be safe mid-flight
+    mgr.save(3, small_state, extra={"epoch": 2}, force=True)
+    restored, extra = mgr.restore(small_state)
+    assert extra["epoch"] == 2
+    mgr.close()
+
+
+def test_async_driver_run_resumes(tmp_path):
+    """The pretrain driver with checkpoint_async=True survives a full
+    train() + auto-resume cycle."""
+    import dataclasses
+
+    from moco_tpu.data.datasets import SyntheticDataset
+    from moco_tpu.train import train
+    from moco_tpu.utils.config import DataConfig, MocoConfig, OptimConfig, TrainConfig
+
+    config = TrainConfig(
+        moco=MocoConfig(
+            arch="resnet18", dim=16, num_negatives=32, mlp=True,
+            shuffle="none", cifar_stem=True, compute_dtype="float32",
+        ),
+        optim=OptimConfig(lr=0.03, epochs=1, cos=True),
+        data=DataConfig(dataset="synthetic", image_size=16, global_batch=16, num_workers=2),
+        workdir=str(tmp_path / "pre_async"),
+        log_every=100,
+        checkpoint_async=True,
+    )
+    dataset = SyntheticDataset(num_examples=32, image_size=16)
+    train(config, dataset=dataset)
+    # second run resumes from the async-written checkpoint
+    config2 = dataclasses.replace(
+        config, optim=dataclasses.replace(config.optim, epochs=2)
+    )
+    out = train(config2, dataset=dataset)
+    assert out["epoch"] == 1

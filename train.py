@@ -96,6 +96,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--knn-every-epochs", type=int, default=None,
         help="periodic frozen-feature kNN monitor (0 = off)",
     )
+    p.add_argument(
+        "--checkpoint-async", action="store_true", default=None,
+        help="overlap checkpoint writes with training (Orbax async); the "
+        "preemption save still blocks until durable",
+    )
     # parallel / infra
     p.add_argument("--num-data", type=int, default=None, help="data-axis size (default: all devices)")
     p.add_argument("--num-model", type=int, default=None, help="model-axis size (shards the queue)")
@@ -169,6 +174,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         log_every=args.print_freq,
         steps_per_epoch=args.steps_per_epoch,
         knn_every_epochs=args.knn_every_epochs,
+        checkpoint_async=args.checkpoint_async,
     )
 
 
