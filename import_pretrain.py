@@ -1,0 +1,145 @@
+"""Import a reference MoCo `.pth.tar` into a native Orbax checkpoint.
+
+    python import_pretrain.py checkpoint_0199.pth.tar /ckpt/imported \
+        [--arch resnet50] [--moco-t 0.2] [--steps-per-epoch 5004]
+
+The output workdir is a first-class pretrain checkpoint: `train.py
+--workdir /ckpt/imported ...` auto-resumes from it (EMA encoder, BN
+running stats, queue + pointer all restored), and `eval_lincls.py
+--pretrained /ckpt/imported` / `convert_pretrain.py` consume it
+directly. See moco_tpu/import_torch.py for the weight-layout inverse
+(reference save format: `main_moco.py:~L312-320`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("checkpoint", help="reference .pth.tar (torch)")
+    p.add_argument("workdir", help="output Orbax checkpoint dir")
+    p.add_argument("--arch", default=None, help="default: the checkpoint's own 'arch'")
+    p.add_argument("--moco-t", type=float, default=None,
+                   help="temperature to record (default 0.2 if MLP head else 0.07)")
+    p.add_argument("--steps-per-epoch", type=int, default=None,
+                   help="sets the imported global step to epoch*steps (LR-schedule "
+                   "position on resume); default leaves step=0")
+    return p
+
+
+def main() -> None:
+    args = build_parser().parse_args()
+    from moco_tpu.utils.platform import pin_platform_from_env
+
+    pin_platform_from_env()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import torch
+
+    from moco_tpu.core import build_encoder, create_state
+    from moco_tpu.import_torch import import_reference_state_dict
+    from moco_tpu.utils.checkpoint import CheckpointManager
+    from moco_tpu.utils.config import DataConfig, MocoConfig, OptimConfig, TrainConfig, config_to_dict
+    from moco_tpu.utils.schedules import build_optimizer
+
+    blob = torch.load(args.checkpoint, map_location="cpu", weights_only=False)
+    state_dict = blob.get("state_dict", blob)
+    state_dict = {k: v.numpy() if hasattr(v, "numpy") else v for k, v in state_dict.items()}
+    arch = args.arch or blob.get("arch")
+    if not arch:
+        sys.exit("checkpoint carries no 'arch' — pass --arch")
+    ckpt_epoch = int(blob.get("epoch", 0))  # reference: number of COMPLETED epochs
+
+    pieces = import_reference_state_dict(state_dict, arch)
+    mlp = bool(pieces.get("mlp"))
+    dim = int(pieces["dim"])
+    num_negatives = int(pieces["queue"].shape[0]) if "queue" in pieces else 65536
+    temperature = args.moco_t if args.moco_t is not None else (0.2 if mlp else 0.07)
+
+    # stem kind from the imported tree itself (import_torch disambiguates
+    # by conv1 kernel size): a CIFAR-stem checkpoint must get a matching
+    # template or graft() would die on tree-structure mismatch
+    cifar_stem = "ConvBN_0" in pieces["params_q"]["backbone"]
+    config = TrainConfig(
+        moco=MocoConfig(
+            arch=arch, dim=dim, num_negatives=num_negatives,
+            temperature=temperature, mlp=mlp, cifar_stem=cifar_stem,
+        ),
+        optim=OptimConfig(lr=0.03, epochs=200, cos=mlp),
+        data=DataConfig(dataset="imagefolder"),
+        workdir=args.workdir,
+    )
+    encoder = build_encoder(config.moco)
+    tx = build_optimizer(config.optim, steps_per_epoch=args.steps_per_epoch or 5004)
+    template = create_state(
+        jax.random.PRNGKey(0), config, encoder, tx,
+        jnp.zeros((1, config.data.image_size, config.data.image_size, 3), jnp.float32),
+    )
+
+    def graft(tmpl, imported, what):
+        """Imported tree must match the template's structure and shapes
+        exactly — a silent partial graft would be a broken checkpoint."""
+        t_flat = jax.tree_util.tree_flatten_with_path(tmpl)[0]
+        i_leaves, i_def = jax.tree_util.tree_flatten(imported)
+        t_def = jax.tree_util.tree_structure(tmpl)
+        if t_def != i_def:
+            sys.exit(f"{what}: tree structure mismatch\n template={t_def}\n imported={i_def}")
+        out = []
+        for (path, t_leaf), i_leaf in zip(t_flat, i_leaves):
+            if tuple(np.shape(t_leaf)) != tuple(np.shape(i_leaf)):
+                name = jax.tree_util.keystr(path)
+                sys.exit(
+                    f"{what}{name}: shape {np.shape(i_leaf)} != template {np.shape(t_leaf)}"
+                )
+            out.append(jnp.asarray(i_leaf, jnp.asarray(t_leaf).dtype))
+        return jax.tree_util.tree_unflatten(t_def, out)
+
+    state = template.replace(
+        params_q=graft(template.params_q, pieces["params_q"], "params_q"),
+        batch_stats_q=graft(template.batch_stats_q, pieces["batch_stats_q"], "batch_stats_q"),
+    )
+    if "params_k" in pieces:
+        state = state.replace(
+            params_k=graft(template.params_k, pieces["params_k"], "params_k"),
+            batch_stats_k=graft(template.batch_stats_k, pieces["batch_stats_k"], "batch_stats_k"),
+        )
+    else:  # v1-style partial saves: key encoder starts as a copy of q
+        state = state.replace(
+            params_k=jax.tree.map(jnp.copy, state.params_q),
+            batch_stats_k=jax.tree.map(jnp.copy, state.batch_stats_q),
+        )
+    if "queue" in pieces:
+        state = state.replace(
+            queue=graft(template.queue, pieces["queue"], "queue"),
+            queue_ptr=jnp.asarray(pieces.get("queue_ptr", 0), jnp.int32),
+        )
+    step = ckpt_epoch * args.steps_per_epoch if args.steps_per_epoch else 0
+    state = state.replace(step=jnp.asarray(step, jnp.int32))
+
+    mgr = CheckpointManager(args.workdir)
+    mgr.save(
+        step,
+        state,
+        extra={
+            "epoch": ckpt_epoch - 1,
+            "config": config_to_dict(config),
+            "num_data": 1,
+            "imported_from": args.checkpoint,
+        },
+        force=True,
+    )
+    mgr.close()
+    print(
+        f"imported {args.checkpoint} (arch={arch}, dim={dim}, mlp={mlp}, "
+        f"K={num_negatives}, epoch={ckpt_epoch}) -> {args.workdir}"
+    )
+
+
+if __name__ == "__main__":
+    main()

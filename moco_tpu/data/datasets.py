@@ -362,34 +362,41 @@ def build_dataset(
             from moco_tpu.data.cache import _read_stamp
 
             flat = root == data_dir
-            primary = "all" if flat else ("train" if train else "val")
-            # flat layout: both splits are the same data, so ANY stamped
-            # subdir whose root matches serves (legacy caches included).
-            # split layout: only this split's subdir or "all" may serve —
-            # the other split is different data.
+            req = "train" if train else "val"
+            primary = "all" if flat else req
+            # Pass 1 — exact stamp-root match. Flat layout: both splits
+            # are the same data, so ANY matching stamped subdir serves
+            # (legacy caches included). Split layout: only this split's
+            # subdir or "all" may serve — the other split is different
+            # data (the root check enforces that).
             candidates = ["all", "train", "val"] if flat else [primary, "all"]
-            split = primary
+            split = None
             for cand in dict.fromkeys(candidates):
                 stamp = _read_stamp(os.path.join(cache_dir, cand))
-                if not stamp:
-                    continue
-                if stamp.get("root") in (None, os.path.realpath(root)):
+                if stamp and stamp.get("root") in (None, os.path.realpath(root)):
                     split = cand
                     break
-                if not os.path.isdir(root):
-                    # can't distinguish "source deleted after caching"
-                    # from a typo'd --data-dir: serve the self-contained
-                    # cache but say so loudly
-                    import warnings
+            if split is None and not os.path.isdir(root):
+                # Pass 2 — the source is gone, so no stamp can match and
+                # the layout is undetectable. Prefer the REQUESTED
+                # split's cache (a gone split-layout val request must not
+                # silently get the train cache), then "all", then the
+                # other split as a last resort. Loud either way: this is
+                # indistinguishable from a typo'd --data-dir.
+                other = "val" if req == "train" else "train"
+                for cand in dict.fromkeys([req, "all", other]):
+                    stamp = _read_stamp(os.path.join(cache_dir, cand))
+                    if stamp:
+                        import warnings
 
-                    warnings.warn(
-                        f"data_dir {root!r} does not exist; serving RGB cache "
-                        f"{cand!r} built from {stamp.get('root')!r} — if this is "
-                        "a mistyped --data-dir, fix it"
-                    )
-                    split = cand
-                    break
-            split_cache = os.path.join(cache_dir, split)
+                        warnings.warn(
+                            f"data_dir {root!r} does not exist; serving RGB cache "
+                            f"{cand!r} built from {stamp.get('root')!r} — if this "
+                            "is a mistyped --data-dir, fix it"
+                        )
+                        split = cand
+                        break
+            split_cache = os.path.join(cache_dir, split or primary)
             build_rgb_cache(
                 lambda: ImageFolderDataset(root, decode_size=decode_size),
                 split_cache,

@@ -1,0 +1,194 @@
+"""Checkpoint IMPORT: migrate a reference `.pth.tar` into this framework.
+
+The reference's artifact of record is a torch checkpoint
+(`main_moco.py:~L312-320`: `{'epoch','arch','state_dict','optimizer'}`
+with DDP-prefixed keys `module.encoder_q.*`, `module.encoder_k.*`,
+`module.queue`, `module.queue_ptr`). A user switching frameworks brings
+those files along — this module is the inverse of `moco_tpu/export.py`:
+torch/torchvision weight layout → Flax trees, then a full `MocoState`
+saved as an Orbax checkpoint that `train.py --resume`-style auto-resume,
+`eval_lincls.py`, and `convert_pretrain.py` consume directly.
+
+What transfers: both encoders' params + BN running stats, the MLP/linear
+head, the negative queue and its pointer ((dim, K) column layout →
+our (K, dim) rows), and the epoch counter. The torch SGD momentum
+buffers are NOT mapped — the optimizer state starts fresh, which the
+reference itself treats as acceptable for transfer (its lincls/detection
+consumers drop the optimizer too).
+
+Weight-layout rules (inverse of export.py):
+- conv (Cout, Cin, H, W) → (H, W, Cin, Cout)
+- dense (Cout, Cin) → (Cin, Cout)
+- BatchNorm weight→scale, bias→bias, running_mean→mean, running_var→var
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from moco_tpu.export import STAGE_SIZES
+
+__all__ = [
+    "torchvision_to_resnet",
+    "head_from_torch",
+    "import_reference_state_dict",
+]
+
+
+def _conv(w: np.ndarray) -> np.ndarray:
+    return np.asarray(w, np.float32).transpose(2, 3, 1, 0)
+
+
+def _dense(w: np.ndarray) -> np.ndarray:
+    return np.asarray(w, np.float32).T
+
+
+def _f32(w) -> np.ndarray:
+    return np.asarray(w, np.float32)
+
+
+def _convbn(sd: Dict[str, Any], conv: str, bn: str) -> Tuple[dict, dict]:
+    params = {
+        "Conv_0": {"kernel": _conv(sd[f"{conv}.weight"])},
+        "BatchNorm_0": {"scale": _f32(sd[f"{bn}.weight"]), "bias": _f32(sd[f"{bn}.bias"])},
+    }
+    stats = {
+        "BatchNorm_0": {
+            "mean": _f32(sd[f"{bn}.running_mean"]),
+            "var": _f32(sd[f"{bn}.running_var"]),
+        }
+    }
+    return params, stats
+
+
+def torchvision_to_resnet(
+    sd: Dict[str, Any], stage_sizes=(3, 4, 6, 3)
+) -> Tuple[dict, dict]:
+    """torchvision-named ResNet state dict → (params, batch_stats) Flax
+    trees matching `moco_tpu.models.resnet` — exact inverse of
+    `export.resnet_to_torchvision` (round-trip tested)."""
+    params: dict = {}
+    stats: dict = {}
+    # ImageNet stem (conv1 7x7). The CIFAR-stem variant exports under the
+    # same torchvision names, so the kernel size disambiguates on import.
+    k = np.asarray(sd["conv1.weight"])
+    stem_p = {
+        "kernel": _conv(sd["conv1.weight"]),
+    }
+    bn_p = {"scale": _f32(sd["bn1.weight"]), "bias": _f32(sd["bn1.bias"])}
+    bn_s = {"mean": _f32(sd["bn1.running_mean"]), "var": _f32(sd["bn1.running_var"])}
+    if k.shape[-1] == 7:  # ImageNet stem: top-level Conv_0/BatchNorm_0
+        params["Conv_0"] = stem_p
+        params["BatchNorm_0"] = bn_p
+        stats["BatchNorm_0"] = bn_s
+    else:  # CIFAR stem: a ConvBN_0 submodule
+        params["ConvBN_0"] = {"Conv_0": stem_p, "BatchNorm_0": bn_p}
+        stats["ConvBN_0"] = {"BatchNorm_0": bn_s}
+
+    # block class from the conv count of the first block
+    is_bottleneck = "layer1.0.conv3.weight" in sd
+    n_main = 3 if is_bottleneck else 2
+    block_cls = "Bottleneck" if is_bottleneck else "BasicBlock"
+    idx = 0
+    for stage, num_blocks in enumerate(stage_sizes):
+        for j in range(num_blocks):
+            prefix = f"layer{stage + 1}.{j}"
+            bp: dict = {}
+            bs: dict = {}
+            for c in range(n_main):
+                p, s = _convbn(sd, f"{prefix}.conv{c + 1}", f"{prefix}.bn{c + 1}")
+                bp[f"ConvBN_{c}"] = p
+                bs[f"ConvBN_{c}"] = s
+            if f"{prefix}.downsample.0.weight" in sd:
+                bp[f"ConvBN_{n_main}"] = {
+                    "Conv_0": {"kernel": _conv(sd[f"{prefix}.downsample.0.weight"])},
+                    "BatchNorm_0": {
+                        "scale": _f32(sd[f"{prefix}.downsample.1.weight"]),
+                        "bias": _f32(sd[f"{prefix}.downsample.1.bias"]),
+                    },
+                }
+                bs[f"ConvBN_{n_main}"] = {
+                    "BatchNorm_0": {
+                        "mean": _f32(sd[f"{prefix}.downsample.1.running_mean"]),
+                        "var": _f32(sd[f"{prefix}.downsample.1.running_var"]),
+                    }
+                }
+            params[f"{block_cls}_{idx}"] = bp
+            stats[f"{block_cls}_{idx}"] = bs
+            idx += 1
+    return params, stats
+
+
+def head_from_torch(sd: Dict[str, Any]) -> Tuple[dict, bool]:
+    """Reference head keys → ProjectionHead params. v2 MLP surgery
+    (`moco/builder.py:~L25-30`: `fc = Sequential(Linear, ReLU, Linear)`)
+    exports `fc.0.*`/`fc.2.*`; v1 keeps a single `fc.*`. Returns
+    (head_params, mlp)."""
+    if "fc.0.weight" in sd:
+        return {
+            "Dense_0": {"kernel": _dense(sd["fc.0.weight"]), "bias": _f32(sd["fc.0.bias"])},
+            "Dense_1": {"kernel": _dense(sd["fc.2.weight"]), "bias": _f32(sd["fc.2.bias"])},
+        }, True
+    if "fc.weight" in sd:
+        return {
+            "Dense_0": {"kernel": _dense(sd["fc.weight"]), "bias": _f32(sd["fc.bias"])},
+        }, False
+    raise KeyError("no fc head keys found in the reference state dict")
+
+
+def _split_prefix(state_dict: Dict[str, Any], prefix: str) -> Dict[str, Any]:
+    out = {}
+    for k, v in state_dict.items():
+        if k.startswith(prefix):
+            out[k[len(prefix) :]] = v
+    return out
+
+
+def import_reference_state_dict(
+    state_dict: Dict[str, Any], arch: str
+) -> Dict[str, Any]:
+    """Reference (DDP-prefixed) state dict → a dict of Flax-side pieces:
+    {params_q, batch_stats_q, params_k, batch_stats_k, queue, queue_ptr,
+    mlp, dim}. Tensors come in as anything np.asarray handles (torch
+    tensors included, via .numpy() upstream)."""
+    if arch not in STAGE_SIZES:
+        raise ValueError(f"unsupported arch for import: {arch!r}")
+    stage_sizes = STAGE_SIZES[arch]
+    pieces: Dict[str, Any] = {}
+    for enc, (pkey, skey) in {
+        "module.encoder_q.": ("params_q", "batch_stats_q"),
+        "module.encoder_k.": ("params_k", "batch_stats_k"),
+    }.items():
+        sub = _split_prefix(state_dict, enc)
+        if not sub and enc == "module.encoder_q.":
+            # tolerate non-DDP checkpoints (single-GPU runs save without
+            # the `module.` wrapper)
+            sub = _split_prefix(state_dict, "encoder_q.")
+        if not sub and enc == "module.encoder_k.":
+            sub = _split_prefix(state_dict, "encoder_k.")
+        if not sub:
+            continue
+        backbone_p, backbone_s = torchvision_to_resnet(sub, stage_sizes)
+        head_p, mlp = head_from_torch(sub)
+        pieces[pkey] = {"backbone": backbone_p, "head": head_p}
+        pieces[skey] = {"backbone": backbone_s}
+        pieces["mlp"] = mlp
+        pieces["dim"] = int(
+            np.asarray(sub["fc.2.weight" if mlp else "fc.weight"]).shape[0]
+        )
+    if "params_q" not in pieces:
+        raise KeyError(
+            "state dict has no encoder_q keys — is this a MoCo pretrain checkpoint?"
+        )
+    for qk in ("module.queue", "queue"):
+        if qk in state_dict:
+            # reference layout: (dim, K) L2-normalized columns -> (K, dim) rows
+            pieces["queue"] = _f32(state_dict[qk]).T
+            break
+    for pk in ("module.queue_ptr", "queue_ptr"):
+        if pk in state_dict:
+            pieces["queue_ptr"] = int(np.asarray(state_dict[pk]).reshape(-1)[0])
+            break
+    return pieces

@@ -304,3 +304,31 @@ def test_legacy_flat_cache_serves_val_split_too(jpeg_folder, tmp_path):
     assert isinstance(ev, PackedRGBCacheDataset)
     assert not os.path.isdir(os.path.join(cache_dir, "all"))
     assert "train" in ev._data.filename
+
+
+def test_gone_split_layout_val_request_gets_val_cache(tmp_path):
+    """Split layout deleted after caching: a val request must serve the
+    val cache, never silently the train one."""
+    import shutil
+
+    rng = np.random.default_rng(5)
+    data_dir = tmp_path / "data"
+    for split, base in (("train", 10), ("val", 200)):
+        d = data_dir / split / "class_0"
+        d.mkdir(parents=True)
+        for i in range(3):
+            arr = np.full((40, 44, 3), base + i, np.uint8)
+            Image.fromarray(arr).save(d / f"im_{i}.png")
+    cache_dir = str(tmp_path / "c")
+    build_dataset("imagefolder", str(data_dir), image_size=28, cache_dir=cache_dir)
+    ev1 = build_dataset(
+        "imagefolder", str(data_dir), image_size=28, train=False, cache_dir=cache_dir
+    )
+    val_img, _ = ev1.load(0)
+    shutil.rmtree(data_dir)
+    with pytest.warns(UserWarning, match="does not exist"):
+        ev2 = build_dataset(
+            "imagefolder", str(data_dir), image_size=28, train=False, cache_dir=cache_dir
+        )
+    assert "val" in ev2._data.filename
+    np.testing.assert_array_equal(ev2.load(0)[0], val_img)
