@@ -4,8 +4,10 @@ TPU-native `detection/convert-pretrain-to-detectron2.py` (plus a torch
 state-dict export for the wider ecosystem).
 
 Usage:
-    python convert_pretrain.py WORKDIR out.pkl   # detectron2 pickle
+    python convert_pretrain.py WORKDIR out.pkl   # detectron2 pickle (ResNet)
     python convert_pretrain.py WORKDIR out.pth   # torch state_dict
+                                                 # (ResNet->torchvision names,
+                                                 #  ViT->timm names)
 
 The backbone architecture is read from the config stored in the
 checkpoint."""
@@ -19,6 +21,7 @@ from moco_tpu.export import (
     resnet_to_torchvision,
     save_detectron2_pickle,
     save_torch_state_dict,
+    vit_to_timm,
 )
 
 
@@ -35,11 +38,23 @@ def main() -> None:
     # arch and template come from the config stored in the checkpoint
     params, stats, config = load_pretrained_backbone(args.workdir)
     arch = config.moco.arch
-    if arch not in STAGE_SIZES:
-        raise SystemExit(f"export supports the ResNet family only, got {arch!r}")
-    state = resnet_to_torchvision(params, stats, stage_sizes=STAGE_SIZES[arch])
-
     fmt = args.format or ("torch" if args.output.endswith(".pth") else "d2")
+    if arch in STAGE_SIZES:
+        state = resnet_to_torchvision(params, stats, stage_sizes=STAGE_SIZES[arch])
+    elif arch.startswith("vit"):
+        if fmt == "d2":
+            raise SystemExit(
+                "detectron2 export is the R50-C4 detection recipe (ResNet only); "
+                "ViT checkpoints export as a timm state dict (.pth)"
+            )
+        state = vit_to_timm(
+            params,
+            patch_size=config.moco.vit_patch_size or 16,
+            image_size=config.data.image_size,
+        )
+    else:
+        raise SystemExit(f"unsupported arch for export: {arch!r}")
+
     if fmt == "d2":
         save_detectron2_pickle(state, args.output)
     else:

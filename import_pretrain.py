@@ -14,7 +14,6 @@ directly. See moco_tpu/import_torch.py for the weight-layout inverse
 from __future__ import annotations
 
 import argparse
-import dataclasses
 import sys
 
 
@@ -48,7 +47,10 @@ def main() -> None:
     from moco_tpu.utils.config import DataConfig, MocoConfig, OptimConfig, TrainConfig, config_to_dict
     from moco_tpu.utils.schedules import build_optimizer
 
-    blob = torch.load(args.checkpoint, map_location="cpu", weights_only=False)
+    # weights_only: the reference save format is plain tensors/ints/strs
+    # — never opt into full-pickle (code-executing) deserialization for a
+    # file that may come from an untrusted mirror
+    blob = torch.load(args.checkpoint, map_location="cpu", weights_only=True)
     state_dict = blob.get("state_dict", blob)
     state_dict = {k: v.numpy() if hasattr(v, "numpy") else v for k, v in state_dict.items()}
     arch = args.arch or blob.get("arch")

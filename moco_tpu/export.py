@@ -143,3 +143,69 @@ STAGE_SIZES = {
     "resnet101": (3, 4, 23, 3),
     "resnet152": (3, 8, 36, 3),
 }
+
+
+def vit_to_timm(
+    backbone_params: Any, patch_size: int, image_size: int = 224
+) -> Dict[str, np.ndarray]:
+    """Flax ViT (moco_tpu.models.vit) → timm `vision_transformer` names,
+    the GPU ecosystem's lingua franca for ViT weights (the upstream
+    `moco-v3` repo ships `convert_to_deit.py` for the same purpose).
+
+    Layout rules beyond the table in the module docstring:
+    - attention q/k/v kernels (D, H, hd) fuse to timm's single
+      `qkv.weight` (3D, D), rows ordered [q; k; v];
+    - `attn.proj.weight` (D, D) from the out kernel (H, hd, D);
+    - our position embedding is FIXED 2-D sin-cos (v3 paper choice), so
+      timm's learnable `pos_embed` is exported as those values — loading
+      with them frozen (or finetuning them) reproduces our forward.
+
+    GELU caveat: flax's `nn.gelu` is the tanh approximation; timm's
+    default act_layer is exact `nn.GELU`. For bit-level parity build the
+    timm model with `act_layer=partial(nn.GELU, approximate='tanh')`;
+    with the default the divergence is the usual tanh-vs-erf epsilon
+    (harmless for finetuning, visible in feature-level comparisons).
+    """
+    from moco_tpu.models.vit import sincos_2d_posembed
+
+    p = backbone_params
+    out: Dict[str, np.ndarray] = {}
+    kernel = _np(p["patch_embed"]["kernel"])  # (P, P, 3, D)
+    dim = kernel.shape[-1]
+    out["patch_embed.proj.weight"] = kernel.transpose(3, 2, 0, 1)
+    out["patch_embed.proj.bias"] = _np(p["patch_embed"]["bias"])
+    has_cls = "cls_token" in p  # gap-pooled backbones carry no cls token
+    if has_cls:
+        out["cls_token"] = _np(p["cls_token"])
+    out["pos_embed"] = sincos_2d_posembed(
+        dim, image_size // patch_size, cls_token=has_cls
+    )
+
+    blocks = sorted(
+        (k for k in p if k.startswith("block_")), key=lambda k: int(k.split("_")[1])
+    )
+    for i, name in enumerate(blocks):
+        b = p[name]
+        pre = f"blocks.{i}"
+        out[f"{pre}.norm1.weight"] = _np(b["LayerNorm_0"]["scale"])
+        out[f"{pre}.norm1.bias"] = _np(b["LayerNorm_0"]["bias"])
+        attn = b["MultiHeadDotProductAttention_0"]
+        qkv_w = np.concatenate(
+            [_np(attn[k]["kernel"]).reshape(dim, dim).T for k in ("query", "key", "value")]
+        )  # (3D, D)
+        qkv_b = np.concatenate(
+            [_np(attn[k]["bias"]).reshape(dim) for k in ("query", "key", "value")]
+        )
+        out[f"{pre}.attn.qkv.weight"] = qkv_w
+        out[f"{pre}.attn.qkv.bias"] = qkv_b
+        out[f"{pre}.attn.proj.weight"] = _np(attn["out"]["kernel"]).reshape(dim, dim).T
+        out[f"{pre}.attn.proj.bias"] = _np(attn["out"]["bias"])
+        out[f"{pre}.norm2.weight"] = _np(b["LayerNorm_1"]["scale"])
+        out[f"{pre}.norm2.bias"] = _np(b["LayerNorm_1"]["bias"])
+        out[f"{pre}.mlp.fc1.weight"] = _np(b["MlpBlock_0"]["Dense_0"]["kernel"]).T
+        out[f"{pre}.mlp.fc1.bias"] = _np(b["MlpBlock_0"]["Dense_0"]["bias"])
+        out[f"{pre}.mlp.fc2.weight"] = _np(b["MlpBlock_0"]["Dense_1"]["kernel"]).T
+        out[f"{pre}.mlp.fc2.bias"] = _np(b["MlpBlock_0"]["Dense_1"]["bias"])
+    out["norm.weight"] = _np(p["final_norm"]["scale"])
+    out["norm.bias"] = _np(p["final_norm"]["bias"])
+    return out

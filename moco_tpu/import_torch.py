@@ -24,7 +24,7 @@ Weight-layout rules (inverse of export.py):
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Tuple
 
 import numpy as np
 

@@ -136,3 +136,84 @@ def test_detectron2_pickle_envelope(tmp_path, r18):
     assert blob["__author__"] == "MOCO"
     assert blob["matching_heuristics"] is True
     assert any(k.startswith("stem.") for k in blob["model"])
+
+
+# ---- ViT -> timm export ---------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def vit_tiny():
+    from moco_tpu.models.vit import create_vit
+
+    m = create_vit("vit_tiny", image_size=32, patch_size=4)
+    v = m.init(jax.random.PRNGKey(3), jnp.zeros((1, 32, 32, 3)), train=False)
+    return m, v["params"]
+
+
+def test_vit_timm_key_inventory(vit_tiny):
+    from moco_tpu.export import vit_to_timm
+
+    _, params = vit_tiny
+    sd = vit_to_timm(params, patch_size=4, image_size=32)
+    for k in (
+        "patch_embed.proj.weight", "patch_embed.proj.bias", "cls_token",
+        "pos_embed", "norm.weight", "norm.bias",
+        "blocks.0.attn.qkv.weight", "blocks.0.attn.proj.weight",
+        "blocks.3.mlp.fc2.bias",
+    ):
+        assert k in sd, k
+    d = sd["patch_embed.proj.weight"].shape[0]
+    assert sd["blocks.0.attn.qkv.weight"].shape == (3 * d, d)
+    assert sd["pos_embed"].shape == (1, 1 + (32 // 4) ** 2, d)
+
+
+def test_vit_timm_forward_parity(vit_tiny):
+    """A timm-style torch forward from the exported dict must reproduce
+    the flax backbone's cls features — the transfer guarantee."""
+    import torch
+
+    from moco_tpu.export import vit_to_timm
+
+    m, params = vit_tiny
+    sd_np = vit_to_timm(params, patch_size=4, image_size=32)
+    sd = {k: torch.from_numpy(np.ascontiguousarray(v)) for k, v in sd_np.items()}
+
+    x = np.asarray(
+        jax.random.normal(jax.random.PRNGKey(9), (2, 32, 32, 3)), np.float32
+    )
+    want = np.asarray(m.apply({"params": params}, jnp.asarray(x), train=False))
+
+    heads, depth = 3, 4
+    t = torch.from_numpy(x).permute(0, 3, 1, 2)  # NCHW
+    t = F.conv2d(
+        t, sd["patch_embed.proj.weight"].float(),
+        bias=sd["patch_embed.proj.bias"].float(), stride=4,
+    )
+    b, d, gh, gw = t.shape
+    t = t.flatten(2).transpose(1, 2)  # (B, N, D) row-major tokens
+    cls = sd["cls_token"].expand(b, -1, -1)
+    t = torch.cat([cls, t], dim=1) + sd["pos_embed"].float()
+    hd = d // heads
+    for i in range(depth):
+        pre = f"blocks.{i}"
+        y = F.layer_norm(t, (d,), sd[f"{pre}.norm1.weight"], sd[f"{pre}.norm1.bias"], eps=1e-6)
+        qkv = F.linear(y, sd[f"{pre}.attn.qkv.weight"], sd[f"{pre}.attn.qkv.bias"])
+        q, k, v = qkv.chunk(3, dim=-1)
+
+        def split(z):
+            return z.view(b, -1, heads, hd).transpose(1, 2)  # (B, H, N, hd)
+
+        q, k, v = split(q), split(k), split(v)
+        attn = (q @ k.transpose(-2, -1)) / hd**0.5
+        y = (attn.softmax(dim=-1) @ v).transpose(1, 2).reshape(b, -1, d)
+        y = F.linear(y, sd[f"{pre}.attn.proj.weight"], sd[f"{pre}.attn.proj.bias"])
+        t = t + y
+        y = F.layer_norm(t, (d,), sd[f"{pre}.norm2.weight"], sd[f"{pre}.norm2.bias"], eps=1e-6)
+        y = F.linear(y, sd[f"{pre}.mlp.fc1.weight"], sd[f"{pre}.mlp.fc1.bias"])
+        # flax nn.gelu defaults to the tanh approximation
+        y = F.gelu(y, approximate="tanh")
+        y = F.linear(y, sd[f"{pre}.mlp.fc2.weight"], sd[f"{pre}.mlp.fc2.bias"])
+        t = t + y
+    t = F.layer_norm(t, (d,), sd["norm.weight"], sd["norm.bias"], eps=1e-6)
+    got = t[:, 0].numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
