@@ -1,0 +1,45 @@
+"""Prebuild the decode-once packed RGB cache (moco_tpu/data/cache.py).
+
+    python scripts/build_cache.py --data-dir /data/imagenet \
+        --cache-dir /ssd/moco_cache [--image-size 224] [--workers 16]
+
+Training with `--cache-dir` builds the cache lazily on first use; on a
+pod you usually want it built ONCE up front (per host, or on a shared
+filesystem) instead of inside the first training step of every job.
+Builds the train and val splits (one shared cache for a flat layout).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data-dir", required=True)
+    ap.add_argument("--cache-dir", required=True)
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--workers", type=int, default=8)
+    args = ap.parse_args()
+
+    from moco_tpu.data.datasets import build_dataset
+
+    for train in (True, False):
+        ds = build_dataset(
+            "imagefolder",
+            args.data_dir,
+            args.image_size,
+            train=train,
+            num_workers=args.workers,
+            cache_dir=args.cache_dir,
+        )
+        split = "train" if train else "val"
+        print(f"{split}: {len(ds)} images cached ({ds.num_classes} classes)")
+
+
+if __name__ == "__main__":
+    main()
