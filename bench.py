@@ -104,6 +104,14 @@ def _ensure_jpeg_folder(root: str, n: int, size: int, classes: int = 8) -> str:
 
 
 def main() -> None:
+    from moco_tpu.utils.platform import backend_usable, pin_platform_from_env
+
+    pin_platform_from_env()  # honor an explicit JAX_PLATFORMS request
+    # A bench that crashes or hangs on a down/wedged tunnel emits NO
+    # metric line at all — degrading to the CPU smoke is strictly better.
+    if not backend_usable():
+        print("accelerator backend unavailable/hung; CPU fallback", file=sys.stderr)
+        jax.config.update("jax_platforms", "cpu")
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
 

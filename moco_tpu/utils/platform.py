@@ -25,3 +25,33 @@ def pin_platform_from_env() -> None:
         import jax
 
         jax.config.update("jax_platforms", want)
+
+
+def backend_usable(timeout: int = 180) -> bool:
+    """Probe the default accelerator backend in a SUBPROCESS with a
+    timeout; True when `jax.devices()` succeeds there.
+
+    The remote-TPU tunnel fails two ways: a fast UNAVAILABLE error, or
+    an indefinite HANG in backend init (busy chip / wedged lease) that
+    no in-process try/except can bound. Callers use a False return to
+    pin the CPU platform instead of crashing or hanging. The timed-out
+    probe is ABANDONED, never killed — SIGKILLing a TPU client mid-init
+    wedges the chip's lease (measured 1h+; see PROFILE.md provenance).
+
+    A CPU-pinned environment short-circuits to True (the caller's
+    `pin_platform_from_env` makes CPU init safe and instant).
+    """
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        return True
+    import subprocess
+    import sys
+
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import jax; jax.devices()"],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    try:
+        return proc.wait(timeout=timeout) == 0
+    except subprocess.TimeoutExpired:
+        return False

@@ -28,7 +28,16 @@ def main() -> None:
 
     from moco_tpu.data.datasets import build_dataset
 
-    for train in (True, False):
+    # build only the splits that exist: a pretrain-only dataset (train/
+    # without val/) must not die after the expensive train decode, and a
+    # flat layout builds one shared cache via the train pass
+    has_train = os.path.isdir(os.path.join(args.data_dir, "train"))
+    has_val = os.path.isdir(os.path.join(args.data_dir, "val"))
+    if has_train or has_val:
+        passes = ([True] if has_train else []) + ([False] if has_val else [])
+    else:
+        passes = [True]  # flat: both splits share the "all" cache
+    for train in passes:
         ds = build_dataset(
             "imagefolder",
             args.data_dir,
@@ -37,7 +46,7 @@ def main() -> None:
             num_workers=args.workers,
             cache_dir=args.cache_dir,
         )
-        split = "train" if train else "val"
+        split = ("train" if train else "val") if (has_train or has_val) else "all"
         print(f"{split}: {len(ds)} images cached ({ds.num_classes} classes)")
 
 
