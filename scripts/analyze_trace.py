@@ -1,0 +1,116 @@
+"""Summarize a jax.profiler trace: per-op device time + roofline check.
+
+    BENCH_TRACE_DIR=/tmp/trace python bench.py          # capture
+    python scripts/analyze_trace.py /tmp/trace [--steps 20] \
+        [--flops 8.18e12 --bytes 100e9 --peak-tflops 197 --hbm-gbs 819]
+
+Reads the newest `*.trace.json.gz` under the directory (the Perfetto
+JSON the profiler writes next to the xplane proto), aggregates X events
+on the device track by fusion-name bucket, and — when the XLA
+cost-analysis numbers are passed — prints the compute/HBM rooflines the
+way PROFILE.md reports them. This is the exact analysis behind
+PROFILE.md, packaged so the next profiling pass is one command.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+
+
+def load_trace(path: str) -> dict:
+    if os.path.isdir(path):
+        hits = sorted(
+            glob.glob(os.path.join(path, "**", "*.trace.json.gz"), recursive=True),
+            key=os.path.getmtime,
+        )
+        if not hits:
+            sys.exit(f"no *.trace.json.gz under {path}")
+        path = hits[-1]
+    print(f"# {path}")
+    with gzip.open(path) as f:
+        return json.load(f)
+
+
+def device_pids(trace: dict) -> dict:
+    names = {}
+    for e in trace.get("traceEvents", []):
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            names[e["pid"]] = e["args"].get("name", "")
+    return {pid: n for pid, n in names.items() if "TPU" in n or "GPU" in n}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("trace", help="trace dir (or a .trace.json.gz file)")
+    ap.add_argument("--steps", type=int, default=None,
+                    help="steps captured, for ms/step (default: inferred from "
+                    "the jit_* umbrella event count)")
+    ap.add_argument("--top", type=int, default=15)
+    ap.add_argument("--flops", type=float, default=None, help="per-step FLOPs (cost analysis)")
+    ap.add_argument("--bytes", type=float, default=None, help="per-step bytes accessed")
+    ap.add_argument("--peak-tflops", type=float, default=197.0, help="chip peak (v5e bf16 default)")
+    ap.add_argument("--hbm-gbs", type=float, default=819.0, help="chip HBM GB/s (v5e default)")
+    args = ap.parse_args()
+
+    trace = load_trace(args.trace)
+    devs = device_pids(trace)
+    if not devs:
+        sys.exit("no device track in trace (CPU-only capture?)")
+    # aggregate ONE device track: SPMD devices run the same program, and
+    # summing across pids would silently inflate every ms/step figure by
+    # the device count
+    pid = sorted(devs)[0]
+    if len(devs) > 1:
+        print(f"({len(devs)} device tracks; analyzing {devs[pid]})")
+
+    umbrella = re.compile(r"^jit_\w+")
+    buckets: collections.Counter = collections.Counter()
+    counts: collections.Counter = collections.Counter()
+    umbrella_total = 0.0
+    umbrella_n = 0
+    for e in trace["traceEvents"]:
+        if e.get("ph") != "X" or e.get("pid") != pid or "dur" not in e:
+            continue
+        name = e.get("name", "?")
+        if umbrella.match(name):
+            umbrella_total += e["dur"]
+            umbrella_n += 1
+            continue
+        if re.fullmatch(r"\d+", name):  # per-step marker rows
+            continue
+        b = re.sub(r"\.\d+$", "", name)
+        buckets[b] += e["dur"]
+        counts[b] += 1
+
+    steps = args.steps or max(umbrella_n, 1)
+    total = sum(buckets.values())
+    print(f"device: {devs[pid]}")
+    print(f"steps: {steps}   umbrella (jit_*) total: {umbrella_total / 1e3:.1f} ms "
+          f"-> {umbrella_total / steps / 1e3:.2f} ms/step")
+    print(f"attributed op time: {total / steps / 1e3:.2f} ms/step\n")
+    print(f"{'ms/step':>9}  {'%':>5}  {'ops/step':>8}  bucket")
+    for b, d in buckets.most_common(args.top):
+        print(f"{d / steps / 1e3:9.3f}  {100 * d / total:5.1f}  {counts[b] / steps:8.1f}  {b[:70]}")
+
+    if args.flops or args.bytes:
+        print()
+        step_ms = umbrella_total / steps / 1e3
+        # no jit_* umbrella in this capture: print absolute rooflines only
+        pct = (lambda ms: f" ({100 * ms / step_ms:.0f}% of step)") if step_ms else (lambda ms: "")
+        if args.flops:
+            c_ms = args.flops / (args.peak_tflops * 1e12) * 1e3
+            print(f"compute roofline @{args.peak_tflops:.0f} TFLOPS: {c_ms:.1f} ms{pct(c_ms)}")
+        if args.bytes:
+            m_ms = args.bytes / (args.hbm_gbs * 1e9) * 1e3
+            print(f"HBM roofline @{args.hbm_gbs:.0f} GB/s: {m_ms:.1f} ms{pct(m_ms)}")
+
+
+if __name__ == "__main__":
+    main()
