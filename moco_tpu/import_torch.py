@@ -32,6 +32,7 @@ from moco_tpu.export import STAGE_SIZES
 
 __all__ = [
     "torchvision_to_resnet",
+    "timm_to_vit",
     "head_from_torch",
     "import_reference_state_dict",
 ]
@@ -119,6 +120,73 @@ def torchvision_to_resnet(
             stats[f"{block_cls}_{idx}"] = bs
             idx += 1
     return params, stats
+
+
+def timm_to_vit(sd: Dict[str, Any], num_heads: int) -> dict:
+    """timm `vision_transformer` state dict → Flax ViT params
+    (moco_tpu.models.vit) — inverse of `export.vit_to_timm` (round-trip
+    tested). `pos_embed` is dropped: ours is fixed 2-D sin-cos computed
+    in the module (the v3 paper's choice); a timm checkpoint whose
+    learned pos_embed drifted from sincos imports with that drift
+    discarded — acceptable for v3-style checkpoints (they trained with
+    frozen sincos), wrong for ordinary supervised ViTs, so callers
+    should know their checkpoint's provenance."""
+    dim = int(np.asarray(sd["patch_embed.proj.weight"]).shape[0])
+    if dim % num_heads:
+        raise ValueError(f"hidden dim {dim} not divisible by num_heads {num_heads}")
+    hd = dim // num_heads
+    params: dict = {
+        "patch_embed": {
+            "kernel": _conv(sd["patch_embed.proj.weight"]),  # (D,3,P,P)->(P,P,3,D)
+            "bias": _f32(sd["patch_embed.proj.bias"]),
+        },
+        "final_norm": {
+            "scale": _f32(sd["norm.weight"]),
+            "bias": _f32(sd["norm.bias"]),
+        },
+    }
+    if "cls_token" in sd:
+        params["cls_token"] = _f32(sd["cls_token"])
+    i = 0
+    while f"blocks.{i}.norm1.weight" in sd:
+        pre = f"blocks.{i}"
+        qkv_w = np.asarray(sd[f"{pre}.attn.qkv.weight"], np.float32)  # (3D, D)
+        qkv_b = np.asarray(sd[f"{pre}.attn.qkv.bias"], np.float32)
+        attn = {}
+        for j, name in enumerate(("query", "key", "value")):
+            attn[name] = {
+                "kernel": qkv_w[j * dim : (j + 1) * dim].T.reshape(dim, num_heads, hd),
+                "bias": qkv_b[j * dim : (j + 1) * dim].reshape(num_heads, hd),
+            }
+        attn["out"] = {
+            "kernel": _dense(sd[f"{pre}.attn.proj.weight"]).reshape(num_heads, hd, dim),
+            "bias": _f32(sd[f"{pre}.attn.proj.bias"]),
+        }
+        params[f"block_{i}"] = {
+            "LayerNorm_0": {
+                "scale": _f32(sd[f"{pre}.norm1.weight"]),
+                "bias": _f32(sd[f"{pre}.norm1.bias"]),
+            },
+            "MultiHeadDotProductAttention_0": attn,
+            "LayerNorm_1": {
+                "scale": _f32(sd[f"{pre}.norm2.weight"]),
+                "bias": _f32(sd[f"{pre}.norm2.bias"]),
+            },
+            "MlpBlock_0": {
+                "Dense_0": {
+                    "kernel": _dense(sd[f"{pre}.mlp.fc1.weight"]),
+                    "bias": _f32(sd[f"{pre}.mlp.fc1.bias"]),
+                },
+                "Dense_1": {
+                    "kernel": _dense(sd[f"{pre}.mlp.fc2.weight"]),
+                    "bias": _f32(sd[f"{pre}.mlp.fc2.bias"]),
+                },
+            },
+        }
+        i += 1
+    if i == 0:
+        raise KeyError("no blocks.* keys — not a timm ViT state dict")
+    return params
 
 
 def head_from_torch(sd: Dict[str, Any]) -> Tuple[dict, bool]:

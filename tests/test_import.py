@@ -143,3 +143,27 @@ def test_import_cli_produces_probeable_checkpoint(flax_state, tmp_path, monkeypa
     assert cfg.moco.num_negatives == K
     _assert_trees_equal(params, state.params_q["backbone"])
     _assert_trees_equal(stats, state.batch_stats_q["backbone"])
+
+
+def test_vit_timm_roundtrip_exact():
+    """timm_to_vit must invert vit_to_timm bit-exactly (minus pos_embed,
+    which is fixed sincos recomputed by the module)."""
+    from moco_tpu.export import vit_to_timm
+    from moco_tpu.import_torch import timm_to_vit
+    from moco_tpu.models.vit import create_vit
+
+    m = create_vit("vit_tiny", image_size=32, patch_size=4)
+    params = m.init(jax.random.PRNGKey(5), jnp.zeros((1, 32, 32, 3)), train=False)[
+        "params"
+    ]
+    sd = vit_to_timm(params, patch_size=4, image_size=32)
+    back = timm_to_vit(sd, num_heads=3)
+    _assert_trees_equal(back, params)
+
+    # and the imported params drive the SAME forward
+    x = jax.random.normal(jax.random.PRNGKey(6), (2, 32, 32, 3))
+    np.testing.assert_allclose(
+        np.asarray(m.apply({"params": back}, x, train=False)),
+        np.asarray(m.apply({"params": params}, x, train=False)),
+        rtol=1e-6,
+    )
