@@ -39,6 +39,11 @@ def build_parser() -> argparse.ArgumentParser:
         "defaults to the pretrain checkpoint's setting",
     )
     p.add_argument("--workdir", default=None)
+    p.add_argument(
+        "--evaluate", "-e", action="store_true",
+        help="validation-only: load the probe's model_best (or latest) "
+        "and score the val split, no training (main_lincls.py --evaluate)",
+    )
     return p
 
 
@@ -59,13 +64,6 @@ def main() -> None:
     from moco_tpu.utils.checkpoint import CheckpointManager
     from moco_tpu.utils.config import config_from_dict
 
-    # data defaults come from the checkpointed config; flags override
-    mgr = CheckpointManager(args.pretrained)
-    extra = mgr.read_extra()
-    mgr.close()
-    base_data = (
-        config_from_dict(extra["config"]).data if "config" in extra else DataConfig()
-    )
     overrides = {
         k: v
         for k, v in {
@@ -78,8 +76,27 @@ def main() -> None:
         }.items()
         if v is not None
     }
-    data = dataclasses.replace(base_data, **overrides)
 
+    if args.evaluate:
+        # evaluate-only never touches the pretrain workdir (the probe
+        # checkpoint carries both configs); flag overrides apply to the
+        # data config inside evaluate_lincls
+        from moco_tpu.lincls import evaluate_lincls
+
+        result = evaluate_lincls(
+            args.pretrained, probe, workdir=args.workdir, data_overrides=overrides
+        )
+        print(f"Acc@1: {result['acc1']:.3f}")
+        return
+
+    # data defaults come from the checkpointed config; flags override
+    mgr = CheckpointManager(args.pretrained)
+    extra = mgr.read_extra()
+    mgr.close()
+    base_data = (
+        config_from_dict(extra["config"]).data if "config" in extra else DataConfig()
+    )
+    data = dataclasses.replace(base_data, **overrides)
     result = train_lincls(args.pretrained, probe, data=data, workdir=args.workdir)
     print(f"best Acc@1: {result['best_acc1']:.3f}")
 

@@ -19,6 +19,8 @@ Reference semantics reproduced exactly (SURVEY.md §3.2, §2.2 row 10):
 
 from __future__ import annotations
 
+import dataclasses
+import os
 import time
 from typing import Any, Optional
 
@@ -36,8 +38,16 @@ from moco_tpu.models import LinearClassifier
 from moco_tpu.ops.losses import cross_entropy, topk_accuracy
 from moco_tpu.parallel import create_mesh
 from moco_tpu.parallel.mesh import DATA_AXIS
-from moco_tpu.utils.checkpoint import CheckpointManager, save_best
-from moco_tpu.utils.config import DataConfig, OptimConfig, ProbeConfig, TrainConfig
+from moco_tpu.utils.checkpoint import CheckpointManager, restore_best, save_best
+from moco_tpu.utils.config import (
+    DataConfig,
+    OptimConfig,
+    ProbeConfig,
+    TrainConfig,
+    config_from_dict,
+    config_to_dict,
+    dataclass_from_dict,
+)
 from moco_tpu.utils.metrics import AverageMeter, MetricWriter, ProgressMeter
 from moco_tpu.utils.schedules import build_optimizer
 
@@ -197,6 +207,46 @@ def sanity_check(state: ProbeState, pretrained_backbone: Any) -> None:
             raise AssertionError(f"backbone weight changed during probe training: {path}")
 
 
+def _probe_tx(probe: ProbeConfig, steps_per_epoch: int):
+    """The probe optimizer (`main_lincls.py:~L200-210` semantics) —
+    shared by training and the evaluate-only restore template, which
+    must rebuild the exact opt-state pytree."""
+    optim_cfg = OptimConfig(
+        optimizer="sgd",
+        lr=probe.lr,
+        momentum=probe.momentum,
+        weight_decay=probe.weight_decay,
+        cos=False,
+        schedule=probe.schedule,
+        epochs=probe.epochs,
+    )
+    return build_optimizer(optim_cfg, steps_per_epoch)
+
+
+def _probe_template(
+    tx,
+    backbone,
+    classifier,
+    backbone_params,
+    backbone_stats,
+) -> ProbeState:
+    """ProbeState with the exact trees train_lincls checkpoints — built
+    from the SAME tx instance the caller steps/restores with, so the
+    opt-state tree cannot drift. `backbone_params/stats` may be concrete
+    arrays (training) or ShapeDtypeStructs (evaluate-only restore
+    template)."""
+    fc_vars = classifier.init(
+        jax.random.PRNGKey(2), jnp.zeros((1, backbone.num_features), jnp.float32)
+    )
+    return ProbeState(
+        step=jnp.zeros((), jnp.int32),
+        fc_params=fc_vars["params"],
+        backbone_params=backbone_params,
+        backbone_stats=backbone_stats,
+        opt_state=tx.init(fc_vars["params"]),
+    )
+
+
 def train_lincls(
     pretrain_workdir: str,
     probe: ProbeConfig,
@@ -223,28 +273,8 @@ def train_lincls(
     val_pipe = EvalPipeline(data, mesh, train=False, dataset=val_dataset)
     steps_per_epoch = train_pipe.steps_per_epoch
 
-    optim_cfg = OptimConfig(
-        optimizer="sgd",
-        lr=probe.lr,
-        momentum=probe.momentum,
-        weight_decay=probe.weight_decay,
-        cos=False,
-        schedule=probe.schedule,
-        epochs=probe.epochs,
-    )
-    tx = build_optimizer(optim_cfg, steps_per_epoch)  # honors weight_decay
-
-    sample = jnp.zeros((1, data.image_size, data.image_size, 3), jnp.float32)
-    fc_vars = classifier.init(
-        jax.random.PRNGKey(2), jnp.zeros((1, backbone.num_features), jnp.float32)
-    )
-    state = ProbeState(
-        step=jnp.zeros((), jnp.int32),
-        fc_params=fc_vars["params"],
-        backbone_params=backbone_params,
-        backbone_stats=backbone_stats,
-        opt_state=tx.init(fc_vars["params"]),
-    )
+    tx = _probe_tx(probe, steps_per_epoch)
+    state = _probe_template(tx, backbone, classifier, backbone_params, backbone_stats)
     rep = NamedSharding(mesh, P())
     state = jax.tree.map(lambda x: jax.device_put(x, rep), state)
 
@@ -272,7 +302,20 @@ def train_lincls(
         last_val = validate(eval_fn, state, val_pipe)
         writer.write(int(state.step), {"epoch": epoch, "split": "val", **last_val})
         print(f" * Acc@1 {last_val['acc1']:.3f} Acc@5 {last_val['acc5']:.3f}")
-        ckpt.save(epoch, state, extra={"epoch": epoch, "acc1": last_val["acc1"]})
+        # config-carrying like the pretrain checkpoints: evaluate-only
+        # rebuilds the exact template (opt-state tree shape depends on
+        # wd/momentum; fc shape on num_classes) without the caller
+        # re-typing the training flags
+        ckpt.save(
+            epoch,
+            state,
+            extra={
+                "epoch": epoch,
+                "acc1": last_val["acc1"],
+                "probe": dataclasses.asdict(probe),
+                "pretrain_config": config_to_dict(pretrain_config),
+            },
+        )
         if last_val["acc1"] > best_acc1:
             best_acc1 = last_val["acc1"]
             save_best(workdir, state, metric=best_acc1)
@@ -281,6 +324,78 @@ def train_lincls(
     writer.close()
     ckpt.close()
     return {"best_acc1": best_acc1, **last_val}
+
+
+def evaluate_lincls(
+    pretrain_workdir: str,
+    probe: ProbeConfig,
+    pretrain_config: Optional[TrainConfig] = None,
+    data: Optional[DataConfig] = None,
+    workdir: Optional[str] = None,
+    val_dataset=None,
+    data_overrides: Optional[dict] = None,
+) -> dict:
+    """Validation-only mode (`main_lincls.py`'s `--evaluate` flag): load
+    a finished probe run's best snapshot (falling back to the latest
+    epoch checkpoint) and score the full val split — no training.
+    `data_overrides`: field overrides applied on top of the data config
+    resolved from the checkpoint (the CLI's flag passthrough).
+
+    `workdir` is the PROBE workdir (default: the train_lincls naming,
+    `<pretrain_workdir>_lincls`). Probe checkpoints carry their own
+    probe + pretrain configs, so the restore template is rebuilt from
+    the checkpoint — the caller's flags are NOT trusted for
+    template-shaping fields (wd/momentum change the opt-state tree,
+    num_classes the fc shape) — and the probe checkpoint alone is
+    sufficient: nothing is read from the pretrain workdir unless the
+    probe checkpoint predates config-carrying extras."""
+    workdir = workdir or (pretrain_workdir.rstrip("/") + "_lincls")
+    mesh = create_mesh(num_model=1)
+
+    mgr = CheckpointManager(workdir, keep=1)
+    extra = mgr.read_extra()
+    if "probe" in extra:
+        probe = dataclass_from_dict(ProbeConfig, extra["probe"])
+        pretrain_config = config_from_dict(extra["pretrain_config"])
+    elif pretrain_config is None:
+        # pre-config-carrying probe checkpoint: the pretrain workdir's
+        # extras supply the config (a JSON read — no state restore)
+        pre_mgr = CheckpointManager(pretrain_workdir)
+        pretrain_config = config_from_dict(pre_mgr.read_extra()["config"])
+        pre_mgr.close()
+    data = data or pretrain_config.data
+    if data_overrides:
+        data = dataclasses.replace(data, **data_overrides)
+    backbone, classifier = _build_probe_model(pretrain_config, probe.num_classes)
+    val_pipe = EvalPipeline(data, mesh, train=False, dataset=val_dataset)
+
+    # abstract backbone trees: eval needs no pretrain-state read — the
+    # probe checkpoint holds every weight; eval_shape gives the template
+    sample = jnp.zeros((1, data.image_size, data.image_size, 3), jnp.float32)
+    var_shapes = jax.eval_shape(
+        lambda: backbone.init(jax.random.PRNGKey(0), sample, train=False)
+    )
+    template = _probe_template(
+        _probe_tx(probe, max(val_pipe.steps_per_epoch, 1)),
+        backbone,
+        classifier,
+        var_shapes["params"],
+        var_shapes.get("batch_stats", {}),
+    )
+    if os.path.isdir(os.path.join(os.path.abspath(workdir), "best")):
+        state, best_metric = restore_best(workdir, template)
+        print(f"evaluating model_best (saved Acc@1 {best_metric:.3f})")
+    else:
+        state, extra = mgr.restore(template)
+        print(f"no model_best; evaluating latest epoch {extra.get('epoch')}")
+    mgr.close()
+    rep = NamedSharding(mesh, P())
+    state = jax.tree.map(lambda x: jax.device_put(x, rep), state)
+
+    eval_fn = make_eval_step(backbone, classifier, mesh)
+    out = validate(eval_fn, state, val_pipe)
+    print(f" * Acc@1 {out['acc1']:.3f} Acc@5 {out['acc5']:.3f}")
+    return out
 
 
 def validate(eval_fn, state: ProbeState, val_pipe: EvalPipeline) -> dict:

@@ -158,17 +158,23 @@ def config_to_dict(cfg: TrainConfig) -> dict:
     return dataclasses.asdict(cfg)
 
 
+def dataclass_from_dict(cls, sub: dict):
+    """Rebuild a config dataclass from checkpointed JSON: unknown keys
+    are dropped (forward/backward compatibility across field changes)
+    and lists become tuples."""
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        if f.name not in sub:
+            continue
+        v = sub[f.name]
+        if isinstance(v, list):
+            v = tuple(v)
+        kwargs[f.name] = v
+    return cls(**kwargs)
+
+
 def config_from_dict(d: dict) -> TrainConfig:
-    def build(cls, sub):
-        kwargs = {}
-        for f in dataclasses.fields(cls):
-            if f.name not in sub:
-                continue
-            v = sub[f.name]
-            if isinstance(v, list):
-                v = tuple(v)
-            kwargs[f.name] = v
-        return cls(**kwargs)
+    build = dataclass_from_dict
 
     return TrainConfig(
         moco=build(MocoConfig, d.get("moco", {})),

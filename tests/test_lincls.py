@@ -108,3 +108,30 @@ def test_probe_step_is_eval_mode(pretrained):
     # applying twice must be deterministic
     out2 = backbone.apply({"params": params, "batch_stats": stats}, x1, train=False)
     np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+
+
+def test_evaluate_only_mode(tmp_path, pretrained):
+    """`--evaluate` parity (main_lincls.py): validation-only on the
+    saved model_best must reproduce the probe's best val accuracy."""
+    from moco_tpu.lincls import evaluate_lincls, train_lincls
+
+    probe = ProbeConfig(num_classes=10, lr=0.5, epochs=2, schedule=(1, 2))
+    data = dataclasses.replace(pretrained.data)
+    workdir = str(tmp_path / "probe")
+    train_ds = SyntheticDataset(num_examples=32, image_size=16)
+    val_ds = SyntheticDataset(num_examples=32, image_size=16)
+    out = train_lincls(
+        pretrained.workdir, probe, data=data, workdir=workdir,
+        train_dataset=train_ds, val_dataset=val_ds,
+    )
+    # caller flags deliberately WRONG for every template-shaping field
+    # (the checkpoint's own saved probe config must win: wd/momentum
+    # shape the opt-state tree, num_classes the fc kernel), AND a
+    # nonsense pretrain workdir: the probe checkpoint alone suffices
+    wrong = ProbeConfig(num_classes=77, lr=9.9, momentum=0.0, weight_decay=0.5, epochs=1)
+    ev = evaluate_lincls(
+        str(tmp_path / "no_such_pretrain"), wrong, data=data,
+        workdir=workdir, val_dataset=val_ds,
+    )
+    assert ev["acc1"] == pytest.approx(out["best_acc1"], abs=1e-6)
+    assert ev["count"] == 32
