@@ -38,7 +38,12 @@ from moco_tpu.models import LinearClassifier
 from moco_tpu.ops.losses import cross_entropy, topk_accuracy
 from moco_tpu.parallel import create_mesh
 from moco_tpu.parallel.mesh import DATA_AXIS
-from moco_tpu.utils.checkpoint import CheckpointManager, restore_best, save_best
+from moco_tpu.utils.checkpoint import (
+    CheckpointManager,
+    best_exists,
+    restore_best,
+    save_best,
+)
 from moco_tpu.utils.config import (
     DataConfig,
     OptimConfig,
@@ -314,6 +319,10 @@ def train_lincls(
                 "acc1": last_val["acc1"],
                 "probe": dataclasses.asdict(probe),
                 "pretrain_config": config_to_dict(pretrain_config),
+                # the RESOLVED data config this probe actually used —
+                # evaluate-only must score the same dataset, not the
+                # pretrain default the caller may have overridden
+                "data": dataclasses.asdict(data),
             },
         )
         if last_val["acc1"] > best_acc1:
@@ -363,7 +372,14 @@ def evaluate_lincls(
         pre_mgr = CheckpointManager(pretrain_workdir)
         pretrain_config = config_from_dict(pre_mgr.read_extra()["config"])
         pre_mgr.close()
-    data = data or pretrain_config.data
+    if data is None:
+        # prefer the data config the probe ACTUALLY trained with (saved
+        # in its extras); the pretrain default is the legacy fallback
+        data = (
+            dataclass_from_dict(DataConfig, extra["data"])
+            if "data" in extra
+            else pretrain_config.data
+        )
     if data_overrides:
         data = dataclasses.replace(data, **data_overrides)
     backbone, classifier = _build_probe_model(pretrain_config, probe.num_classes)
@@ -382,7 +398,7 @@ def evaluate_lincls(
         var_shapes["params"],
         var_shapes.get("batch_stats", {}),
     )
-    if os.path.isdir(os.path.join(os.path.abspath(workdir), "best")):
+    if best_exists(workdir):
         state, best_metric = restore_best(workdir, template)
         print(f"evaluating model_best (saved Acc@1 {best_metric:.3f})")
     else:

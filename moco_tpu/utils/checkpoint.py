@@ -126,6 +126,12 @@ def _jsonify(extra: dict) -> dict:
     return out
 
 
+def best_exists(directory: str) -> bool:
+    """Whether a `model_best` alias exists under `directory` — the one
+    place that knows the alias layout (keep save/restore/probe in sync)."""
+    return os.path.isdir(os.path.join(os.path.abspath(directory), "best"))
+
+
 def save_best(directory: str, state: Any, metric: float) -> None:
     """`model_best` alias (`main_lincls.py:~L250-260`): overwrite the
     single best-by-metric snapshot."""

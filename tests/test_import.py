@@ -167,3 +167,31 @@ def test_vit_timm_roundtrip_exact():
         np.asarray(m.apply({"params": params}, x, train=False)),
         rtol=1e-6,
     )
+
+
+def test_v1_head_and_nonddp_prefix(flax_state):
+    """v1 checkpoints (single-Linear fc, no MLP) and single-GPU saves
+    (no `module.` DDP prefix) must both import."""
+    _, _, state = flax_state
+    sd = {}
+    back = resnet_to_torchvision(
+        state.params_q["backbone"], state.batch_stats_q["backbone"], STAGE_SIZES[ARCH]
+    )
+    for k, v in back.items():
+        sd["encoder_q." + k] = v  # non-DDP prefix
+    head = state.params_q["head"]
+    # v1-style: a single fc (reuse Dense_0's shapes as the linear head)
+    sd["encoder_q.fc.weight"] = np.asarray(head["Dense_0"]["kernel"]).T
+    sd["encoder_q.fc.bias"] = np.asarray(head["Dense_0"]["bias"])
+
+    pieces = import_reference_state_dict(sd, ARCH)
+    assert not pieces["mlp"]
+    assert pieces["dim"] == sd["encoder_q.fc.weight"].shape[0]
+    assert "params_k" not in pieces  # partial save: only q present
+    _assert_trees_equal(
+        pieces["params_q"]["backbone"], state.params_q["backbone"]
+    )
+    np.testing.assert_array_equal(
+        pieces["params_q"]["head"]["Dense_0"]["kernel"],
+        np.asarray(head["Dense_0"]["kernel"]),
+    )

@@ -128,9 +128,11 @@ def test_evaluate_only_mode(tmp_path, pretrained):
     # (the checkpoint's own saved probe config must win: wd/momentum
     # shape the opt-state tree, num_classes the fc kernel), AND a
     # nonsense pretrain workdir: the probe checkpoint alone suffices
+    # data=None: the probe checkpoint's SAVED data config must drive the
+    # eval pipeline (not the caller, not the pretrain default)
     wrong = ProbeConfig(num_classes=77, lr=9.9, momentum=0.0, weight_decay=0.5, epochs=1)
     ev = evaluate_lincls(
-        str(tmp_path / "no_such_pretrain"), wrong, data=data,
+        str(tmp_path / "no_such_pretrain"), wrong,
         workdir=workdir, val_dataset=val_ds,
     )
     assert ev["acc1"] == pytest.approx(out["best_acc1"], abs=1e-6)
