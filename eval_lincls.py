@@ -28,7 +28,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--schedule", type=int, nargs="*", default=[60, 80])
     p.add_argument("--epochs", type=int, default=100)
     p.add_argument("--num-classes", type=int, default=1000)
-    p.add_argument("--data", dest="dataset", choices=("synthetic", "synthetic_learnable", "cifar10", "imagefolder"), default=None)
+    p.add_argument("--data", dest="dataset", choices=("synthetic", "synthetic_learnable", "synthetic_hard", "cifar10", "imagefolder"), default=None)
     p.add_argument("--data-dir", default=None)
     p.add_argument("--image-size", type=int, default=None)
     p.add_argument("--batch-size", "-b", type=int, default=None)

@@ -77,7 +77,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--warmup-epochs", type=int, default=None)
     p.add_argument("--epochs", type=int, default=None)
     # data (reference: positional DATA, --batch-size, --aug-plus, --workers)
-    p.add_argument("--data", dest="dataset", choices=("synthetic", "synthetic_learnable", "cifar10", "imagefolder"), default=None)
+    p.add_argument("--data", dest="dataset", choices=("synthetic", "synthetic_learnable", "synthetic_hard", "cifar10", "imagefolder"), default=None)
     p.add_argument("--data-dir", default=None)
     p.add_argument("--image-size", type=int, default=None)
     p.add_argument("--batch-size", "-b", type=int, default=None)
