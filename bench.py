@@ -139,6 +139,9 @@ def main() -> None:
             shuffle="gather_perm" if n_dev > 1 else "none",
             cifar_stem=not on_tpu,
             compute_dtype=dtype,
+            # BENCH_BN_STATS_ROWS=32 A/Bs the subset-statistics BN (the
+            # PROFILE.md byte-reduction lever) without code changes
+            bn_stats_rows=int(os.environ.get("BENCH_BN_STATS_ROWS", 0)),
         ),
         optim=OptimConfig(lr=0.03, epochs=200, cos=True),
         data=DataConfig(dataset="synthetic", image_size=img, global_batch=batch),

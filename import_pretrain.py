@@ -27,6 +27,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--steps-per-epoch", type=int, default=None,
                    help="sets the imported global step to epoch*steps (LR-schedule "
                    "position on resume); default leaves step=0")
+    p.add_argument("--lr", type=float, default=None,
+                   help="the ORIGINAL training run's lr, recorded in the config "
+                   "(default 0.03 = the reference recipe, marked as guessed)")
+    p.add_argument("--epochs", type=int, default=None,
+                   help="the ORIGINAL run's total epochs, recorded in the config "
+                   "(default 200, marked as guessed)")
     return p
 
 
@@ -68,12 +74,28 @@ def main() -> None:
     # by conv1 kernel size): a CIFAR-stem checkpoint must get a matching
     # template or graft() would die on tree-structure mismatch
     cifar_stem = "ConvBN_0" in pieces["params_q"]["backbone"]
+    # A torch checkpoint does not record its optimizer hyperparameters;
+    # anything not passed via flags is filled with the reference recipe's
+    # defaults and LISTED as guessed in the saved extras, so downstream
+    # config readers can tell provenance from measurement.
+    guessed = ["data.dataset"]
+    if args.lr is None:
+        guessed.append("optim.lr")
+    if args.epochs is None:
+        guessed.append("optim.epochs")
+    if args.moco_t is None:
+        guessed.append("moco.temperature")
+    guessed.append("optim.cos")
     config = TrainConfig(
         moco=MocoConfig(
             arch=arch, dim=dim, num_negatives=num_negatives,
             temperature=temperature, mlp=mlp, cifar_stem=cifar_stem,
         ),
-        optim=OptimConfig(lr=0.03, epochs=200, cos=mlp),
+        optim=OptimConfig(
+            lr=args.lr if args.lr is not None else 0.03,
+            epochs=args.epochs if args.epochs is not None else 200,
+            cos=mlp,
+        ),
         data=DataConfig(dataset="imagefolder"),
         workdir=args.workdir,
     )
@@ -133,6 +155,9 @@ def main() -> None:
             "config": config_to_dict(config),
             "num_data": 1,
             "imported_from": args.checkpoint,
+            # which recorded config fields are recipe-default guesses,
+            # not values the original run actually used (ADVICE r2)
+            "config_guessed_fields": guessed,
         },
         force=True,
     )

@@ -72,6 +72,10 @@ def create_backbone(cfg: MocoConfig, num_data: Optional[int] = None) -> nn.Modul
         # a ResNet would double backbone grads over the model axis
         raise ValueError(f"vit_sequence_parallel requires a ViT arch, got {cfg.arch!r}")
     if cfg.arch.startswith("vit"):
+        if cfg.bn_stats_rows:
+            # must fail loudly: a ViT has no BatchNorm, the lever would be
+            # inert while the checkpoint config records it as active
+            raise ValueError("bn_stats_rows applies to ResNet BatchNorm, not ViT archs")
         from moco_tpu.models.vit import create_vit
 
         vit_kw = {"patch_size": cfg.vit_patch_size} if cfg.vit_patch_size else {}
@@ -108,6 +112,7 @@ def create_backbone(cfg: MocoConfig, num_data: Optional[int] = None) -> nn.Modul
         dtype=dtype,
         bn_cross_replica_axis=syncbn_axis,
         bn_axis_index_groups=groups,
+        bn_stats_rows=cfg.bn_stats_rows,
     )
 
 

@@ -27,12 +27,13 @@ Safety properties:
   loader's cross-process build lock) and write per-pid temp names, so
   concurrent processes sharing a cache_dir cannot interleave writes;
 - the stamp records the SOURCE identity (root path + a fingerprint of
-  the (path, label) listing); reuse verifies both, so a cache from a
-  different source, or one whose source gained/lost images or classes,
-  raises instead of silently serving the wrong pixels. (If the source
-  directory is gone the self-contained cache is trusted as-is.) Pixel
-  content edited in-place under identical file names is the one drift
-  this cannot see — delete the cache_dir to force a rebuild;
+  the (path, label, file-size) listing); reuse verifies both, so a cache
+  from a different source, one whose source gained/lost images or
+  classes, or files re-encoded in place under identical names (size
+  drift) raises instead of silently serving the wrong pixels. (If the
+  source directory is gone the self-contained cache is trusted as-is.)
+  A same-size in-place pixel edit is the one drift this cannot see —
+  delete the cache_dir to force a rebuild;
 - a cache built at one canvas size grows canvases for new sizes on
   demand from data.bin (no re-decode), so changing image_size never
   silently drops the mmap fast path.
@@ -50,11 +51,25 @@ import numpy as np
 __all__ = ["PackedRGBCacheDataset", "build_rgb_cache"]
 
 
-def _fingerprint(samples) -> str:
+def _fingerprint(samples, legacy: bool = False) -> str:
+    """Identity of the source listing. v2 folds each file's SIZE into the
+    per-sample hash so files re-encoded in place under identical names
+    (e.g. a synthetic folder regenerated with new constants) are caught
+    as drift, not served stale. `legacy=True` reproduces the pre-size
+    format so caches stamped before v2 still verify instead of being
+    invalidated wholesale."""
     h = hashlib.sha256()
     for path, label in samples:
-        h.update(f"{os.path.basename(path)}\0{label}\n".encode())
-    return f"{len(samples)}:{h.hexdigest()[:16]}"
+        if legacy:
+            h.update(f"{os.path.basename(path)}\0{label}\n".encode())
+        else:
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                size = -1
+            h.update(f"{os.path.basename(path)}\0{label}\0{size}\n".encode())
+    prefix = "" if legacy else "v2:"
+    return f"{prefix}{len(samples)}:{h.hexdigest()[:16]}"
 
 
 def _read_stamp(cache_dir: str) -> Optional[dict]:
@@ -131,7 +146,8 @@ def build_rgb_cache(
                 # that exists but lost its images) must propagate: that IS
                 # the drift the fingerprint check exists to catch.
                 source = None
-            if source is not None and _fingerprint(source.samples) != stamp["fingerprint"]:
+            legacy = not stamp["fingerprint"].startswith("v2:")
+            if source is not None and _fingerprint(source.samples, legacy=legacy) != stamp["fingerprint"]:
                 raise ValueError(
                     f"RGB cache at {cache_dir} is stale: the source listing under "
                     f"{stamp.get('root') or root_real!r} changed since the build "

@@ -122,16 +122,37 @@ def torchvision_to_resnet(
     return params, stats
 
 
-def timm_to_vit(sd: Dict[str, Any], num_heads: int) -> dict:
+def timm_to_vit(sd: Dict[str, Any], num_heads: int, strict_pos_embed: bool = False) -> dict:
     """timm `vision_transformer` state dict → Flax ViT params
     (moco_tpu.models.vit) — inverse of `export.vit_to_timm` (round-trip
     tested). `pos_embed` is dropped: ours is fixed 2-D sin-cos computed
-    in the module (the v3 paper's choice); a timm checkpoint whose
-    learned pos_embed drifted from sincos imports with that drift
-    discarded — acceptable for v3-style checkpoints (they trained with
-    frozen sincos), wrong for ordinary supervised ViTs, so callers
-    should know their checkpoint's provenance."""
+    in the module (the v3 paper's choice). A v3-style checkpoint trained
+    with frozen sincos loses nothing; an ordinary supervised timm ViT
+    carries a LEARNED pos_embed whose information would be silently
+    discarded — so the incoming table is compared against the sincos
+    grid and a drift beyond tolerance warns (or raises with
+    `strict_pos_embed=True`)."""
     dim = int(np.asarray(sd["patch_embed.proj.weight"]).shape[0])
+    if "pos_embed" in sd:
+        pe = np.asarray(sd["pos_embed"], np.float32).reshape(-1, dim)
+        n_tok = pe.shape[0]
+        has_cls = "cls_token" in sd
+        grid = int(round((n_tok - (1 if has_cls else 0)) ** 0.5))
+        from moco_tpu.models.vit import sincos_2d_posembed
+
+        expect = sincos_2d_posembed(dim, grid, cls_token=has_cls).reshape(-1, dim)
+        if expect.shape != pe.shape or not np.allclose(expect, pe, atol=1e-3):
+            msg = (
+                "timm checkpoint carries a pos_embed that differs from the fixed "
+                "2-D sin-cos table this ViT computes — a LEARNED positional "
+                "embedding would be discarded on import (fine for v3-style "
+                "frozen-sincos checkpoints, lossy for supervised timm ViTs)"
+            )
+            if strict_pos_embed:
+                raise ValueError(msg)
+            import warnings
+
+            warnings.warn(msg)
     if dim % num_heads:
         raise ValueError(f"hidden dim {dim} not divisible by num_heads {num_heads}")
     hd = dim // num_heads

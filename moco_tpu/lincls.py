@@ -398,12 +398,28 @@ def evaluate_lincls(
         var_shapes["params"],
         var_shapes.get("batch_stats", {}),
     )
-    if best_exists(workdir):
-        state, best_metric = restore_best(workdir, template)
-        print(f"evaluating model_best (saved Acc@1 {best_metric:.3f})")
-    else:
-        state, extra = mgr.restore(template)
-        print(f"no model_best; evaluating latest epoch {extra.get('epoch')}")
+    legacy_probe_flags = "probe" not in extra
+    try:
+        if best_exists(workdir):
+            state, best_metric = restore_best(workdir, template)
+            print(f"evaluating model_best (saved Acc@1 {best_metric:.3f})")
+        else:
+            state, extra = mgr.restore(template)
+            print(f"no model_best; evaluating latest epoch {extra.get('epoch')}")
+    except Exception as e:
+        if legacy_probe_flags:
+            # pre-config-carrying probe checkpoint: the template was shaped
+            # from the CLI probe flags, so a wd/momentum/num-classes
+            # mismatch with the original probe run surfaces as an Orbax
+            # tree-structure error here — say so instead of the raw trace
+            raise RuntimeError(
+                "probe checkpoint restore failed and this checkpoint predates "
+                "config-carrying extras, so the restore template was built from "
+                "the probe flags you passed — if they differ from the ORIGINAL "
+                "probe training flags (--lr/--wd/--momentum affect the optimizer "
+                "state tree, num_classes the fc shape), pass the original values"
+            ) from e
+        raise
     mgr.close()
     rep = NamedSharding(mesh, P())
     state = jax.tree.map(lambda x: jax.device_put(x, rep), state)

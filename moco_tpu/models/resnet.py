@@ -33,6 +33,75 @@ ModuleDef = Any
 conv_kernel_init = nn.initializers.variance_scaling(2.0, "fan_out", "truncated_normal")
 
 
+class BatchNorm(nn.Module):
+    """`nn.BatchNorm`-compatible BN whose training statistics come from a
+    SUBSET of the batch rows (`stats_rows` per device; 0 = full batch).
+
+    The byte-reduction lever for the BN-bound step (PROFILE.md: the BN
+    statistics reductions are 55% of step time — each training BN
+    re-reads its full activation tensor over and above the conv that
+    produced it). With `stats_rows=r`, the forward statistics passes read
+    only `r/B` of each activation. Statistically this is FAITHFUL to the
+    reference's granularity: upstream trains with per-GPU BatchNorm over
+    batch-256/8-GPUs = 32-row statistics (`main_moco.py:~L172`, DDP
+    per-rank batch), while a 256-row single-chip batch otherwise uses 8x
+    more samples per estimate than the recipe ever did.
+
+    Parameter/variable names and tree paths match `nn.BatchNorm`
+    (class name included), so checkpoints interchange between the modes.
+    Normalization covers ALL rows; gradients flow through the subset
+    statistics exactly as they do through full-batch statistics.
+    `axis_name` composes the subset statistics cross-replica (SyncBN).
+    """
+
+    stats_rows: int = 0
+    use_running_average: bool = False
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: jnp.dtype = jnp.float32
+    axis_name: Optional[str] = None
+    axis_index_groups: Optional[Sequence[Sequence[int]]] = None
+    scale_init: Callable = nn.initializers.ones
+    bias_init: Callable = nn.initializers.zeros
+
+    @nn.compact
+    def __call__(self, x):
+        feats = x.shape[-1]
+        scale = self.param("scale", self.scale_init, (feats,), jnp.float32)
+        bias = self.param("bias", self.bias_init, (feats,), jnp.float32)
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros((feats,), jnp.float32)
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones((feats,), jnp.float32)
+        )
+        if self.stats_rows < 0:
+            raise ValueError(f"stats_rows must be >= 0, got {self.stats_rows}")
+        if self.use_running_average:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            rows = x.shape[0]
+            if self.stats_rows and self.stats_rows < rows:
+                rows = self.stats_rows
+            sub = x[:rows].astype(jnp.float32)
+            reduce_axes = tuple(range(sub.ndim - 1))
+            mean = jnp.mean(sub, axis=reduce_axes)
+            mean2 = jnp.mean(jnp.square(sub), axis=reduce_axes)
+            if self.axis_name is not None and not self.is_initializing():
+                mean, mean2 = jax.lax.pmean(
+                    (mean, mean2),
+                    axis_name=self.axis_name,
+                    axis_index_groups=self.axis_index_groups,
+                )
+            var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
+            if not self.is_initializing():
+                ra_mean.value = self.momentum * ra_mean.value + (1 - self.momentum) * mean
+                ra_var.value = self.momentum * ra_var.value + (1 - self.momentum) * var
+        mul = scale * jax.lax.rsqrt(var + self.epsilon)
+        shift = bias - mean * mul
+        return x * mul.astype(self.dtype) + shift.astype(self.dtype)
+
+
 class ConvBN(nn.Module):
     """Conv (no bias) + BatchNorm, the repeated cell of every block."""
 
@@ -115,6 +184,9 @@ class ResNet(nn.Module):
     # an axis name = SyncBN over that mesh axis (optionally subgrouped).
     bn_cross_replica_axis: Optional[str] = None
     bn_axis_index_groups: Optional[Sequence[Sequence[int]]] = None
+    # Training BN statistics from the first N rows of the (per-device)
+    # batch; 0 = full batch (exact nn.BatchNorm). See BatchNorm above.
+    bn_stats_rows: int = 0
 
     @property
     def num_features(self) -> int:
@@ -122,14 +194,17 @@ class ResNet(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = True):
+        norm_cls = BatchNorm if self.bn_stats_rows else nn.BatchNorm
+        extra = {"stats_rows": self.bn_stats_rows} if self.bn_stats_rows else {}
         norm = functools.partial(
-            nn.BatchNorm,
+            norm_cls,
             use_running_average=not train,
             momentum=self.bn_momentum,
             epsilon=self.bn_epsilon,
             dtype=self.dtype,
             axis_name=self.bn_cross_replica_axis,
             axis_index_groups=self.bn_axis_index_groups,
+            **extra,
         )
         x = x.astype(self.dtype)
         if self.cifar_stem:

@@ -82,7 +82,8 @@ def train(
     # mid-epoch preemption saves); the epoch lives in extras. Save
     # frequency is gated here in the driver, not by Orbax's policy.
     ckpt = CheckpointManager(
-        config.workdir, keep=3, save_interval=1, async_save=config.checkpoint_async
+        config.workdir, keep=config.checkpoint_keep, save_interval=1,
+        async_save=config.checkpoint_async,
     )
     start_epoch = 0
     if ckpt.latest_step() is not None:  # --resume semantics, automatic

@@ -48,7 +48,10 @@ class CheckpointManager:
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
-                max_to_keep=keep,
+                # keep<=0 = retain every checkpoint (the reference keeps
+                # every per-epoch checkpoint_{epoch:04d}.pth.tar,
+                # main_moco.py:~L275-280)
+                max_to_keep=keep if keep > 0 else None,
                 save_interval_steps=save_interval,
                 create=True,
                 enable_async_checkpointing=async_save,

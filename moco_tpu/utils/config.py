@@ -30,6 +30,12 @@ class MocoConfig:
     # 'none' (single-device / ablation).
     shuffle: str = "gather_perm"
     syncbn_group_size: int = 0  # 0 = whole data axis, else subgroups of this size
+    # Training BN statistics from the first N rows of each device's
+    # batch (0 = full batch). Byte-reduction lever for the BN-bound step
+    # (PROFILE.md: stats reductions are 55% of step time) that matches
+    # the reference's statistics granularity — upstream's per-GPU BN
+    # estimates from 32 rows (batch 256 / 8 GPUs, main_moco.py:~L172).
+    bn_stats_rows: int = 0
     cifar_stem: bool = False
     compute_dtype: str = "bfloat16"
     # MoCo v3 (queue-free symmetric contrastive): set num_negatives=0,
@@ -137,6 +143,10 @@ class TrainConfig:
     workdir: str = "/tmp/moco_tpu"
     log_every: int = 10  # --print-freq
     checkpoint_every_epochs: int = 1
+    # Retention: keep the last N checkpoints; 0 keeps EVERY one (the
+    # reference's behavior — per-epoch checkpoint_{epoch:04d}.pth.tar,
+    # main_moco.py:~L275-280).
+    checkpoint_keep: int = 3
     # Overlap checkpoint serialization with training (Orbax async): the
     # save returns after the host snapshot; the write happens on a
     # background thread. The preemption path always waits for durability.
@@ -185,7 +195,7 @@ def config_from_dict(d: dict) -> TrainConfig:
             k: d[k]
             for k in (
                 "seed", "workdir", "log_every", "checkpoint_every_epochs",
-                "checkpoint_async", "steps_per_epoch",
+                "checkpoint_async", "checkpoint_keep", "steps_per_epoch",
             )
             if k in d
         },

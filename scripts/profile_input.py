@@ -1,0 +1,292 @@
+"""Per-stage breakdown of the exact-host-RRC input path (VERDICT r2 #5).
+
+PROFILE.md's with-data ladder showed the host pipeline ~10x below the
+device rate but attributed the ceiling by extrapolation. This script
+measures where each millisecond goes, per batch, for every input mode:
+
+  stages: dims lookup -> RRC box sampling -> source read (JPEG decode
+  or cache mmap) -> crop+resize (PIL or C++ resize_region) -> assemble
+  [-> host-to-device transfer, when an accelerator is attached]
+
+Modes (the same ladder bench.py / PROFILE.md use):
+  jpeg_pil     — ImageFolderDataset: PIL decode + PIL crop/resize
+  jpeg_native  — native/loader.cc decode pool + C++ crops
+  cache_pil    — PackedRGBCacheDataset(use_native=False): mmap + PIL
+  cache_native — PackedRGBCacheDataset: mmap + C++ resize_region
+  cache_canvas — canvas mode: pure mmap row read (host_rrc=False)
+
+The crop stage is additionally swept over thread counts; on a 1-core
+host that curve is expected flat (it measures GIL/pool overhead, not
+parallel speedup) — the per-thread number is what transfers to
+multi-core hosts since both crop backends release the GIL (C++) or run
+in PIL's C core.
+
+Writes artifacts/input_profile.json and a marker-delimited section into
+PROFILE.md. Run:
+    python scripts/profile_input.py            # TPU if healthy, else CPU
+    JAX_PLATFORMS=cpu python scripts/profile_input.py --batches 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from moco_tpu.utils.platform import pin_platform_from_env
+
+pin_platform_from_env()
+
+import numpy as np
+
+MARK_BEGIN = "<!-- input-profile:begin -->"
+MARK_END = "<!-- input-profile:end -->"
+ART_PATH = "artifacts/input_profile.json"
+
+
+def _sample_boxes(dims: np.ndarray, n_crops: int, seed: int, epoch: int, step: int,
+                  idx: np.ndarray, scale=(0.2, 1.0)) -> np.ndarray:
+    """The pipeline's exact per-(row,crop) seeded box sampling
+    (moco_tpu/data/pipeline.py:_put_crop_batch)."""
+    from moco_tpu.data.datasets import sample_rrc_boxes
+
+    boxes = np.empty((len(idx), n_crops, 4), np.int32)
+    for row, ds_idx in enumerate(np.asarray(idx, np.int64)):
+        for c in range(n_crops):
+            rng = np.random.default_rng((seed, epoch, step, int(ds_idx), c))
+            boxes[row, c] = sample_rrc_boxes(rng, dims[row : row + 1], scale=scale)[0]
+    return boxes
+
+
+def _time(fn, reps: int) -> float:
+    """Best-of-reps milliseconds (min filters scheduler noise on the
+    shared single core)."""
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best * 1e3
+
+
+def profile_mode(name: str, dataset, batch: int, out_size: int, reps: int,
+                 pool) -> dict:
+    idx = np.arange(batch) % len(dataset)
+    res = {"mode": name, "batch": batch, "out_size": out_size}
+
+    res["dims_ms"] = _time(lambda: dataset.dims(idx), reps)
+    dims = dataset.dims(idx)
+    res["boxes_ms"] = _time(lambda: _sample_boxes(dims, 2, 0, 0, 0, idx), reps)
+    boxes = _sample_boxes(dims, 2, 0, 0, 0, idx)
+
+    if name == "cache_canvas":
+        # canvas mode has no crop stage: one mmap row read per image
+        res["read_ms"] = _time(
+            lambda: np.stack([dataset.load(int(i))[0] for i in idx]), reps
+        )
+        res["crop_ms"] = 0.0
+        res["total_ms"] = res["dims_ms"] + res["read_ms"]
+        return res
+
+    # full crop-batch stage (read + crop + resize + assembly into the
+    # output array, exactly what the pipeline calls)
+    res["crop_batch_ms"] = _time(
+        lambda: dataset.load_crop_batch(idx, boxes, out_size, pool=pool), reps
+    )
+
+    # source-read sub-stage: decode (JPEG) or mmap slice (cache)
+    if hasattr(dataset, "_image"):  # cache: mmap read + materialize
+        res["read_ms"] = _time(
+            lambda: [np.ascontiguousarray(dataset._image(int(i))) for i in idx], reps
+        )
+    elif hasattr(dataset, "samples"):  # JPEG folder: PIL decode only
+        from PIL import Image
+
+        def decode_all():
+            for i in idx:
+                with Image.open(dataset.samples[int(i)][0]) as im:
+                    np.asarray(im.convert("RGB"))
+
+        res["read_ms"] = _time(decode_all, reps)
+    else:
+        res["read_ms"] = None
+    if res["read_ms"] is not None:
+        res["crop_resize_ms"] = res["crop_batch_ms"] - res["read_ms"]
+    res["total_ms"] = res["dims_ms"] + res["boxes_ms"] + res["crop_batch_ms"]
+    return res
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--out-size", type=int, default=224)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--src-size", type=int, default=256, help="synthetic JPEG geometry")
+    ap.add_argument("--n-images", type=int, default=512)
+    ap.add_argument("--threads", type=int, nargs="*", default=[1, 2, 4, 8])
+    ap.add_argument("--profile-md", default="PROFILE.md")
+    ap.add_argument("--artifact", default=ART_PATH)
+    args = ap.parse_args()
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from bench import _ensure_jpeg_folder
+
+    from moco_tpu.data.cache import PackedRGBCacheDataset, build_rgb_cache
+    from moco_tpu.data.datasets import ImageFolderDataset
+    from moco_tpu.data.native_loader import native_available
+
+    folder = _ensure_jpeg_folder("/tmp/moco_bench_imgfolder", args.n_images, args.src_size)
+    cache_dir = "/tmp/moco_input_profile_cache"
+    build_rgb_cache(
+        lambda: ImageFolderDataset(folder, decode_size=args.src_size),
+        cache_dir, num_workers=1, canvas_size=args.src_size, root=folder,
+    )
+
+    from concurrent.futures import ThreadPoolExecutor
+
+    results = []
+    native = native_available()
+    for threads in args.threads:
+        pool = ThreadPoolExecutor(max_workers=threads)
+        modes = {
+            "jpeg_pil": ImageFolderDataset(folder, decode_size=args.src_size),
+            "cache_pil": PackedRGBCacheDataset(
+                cache_dir, decode_size=args.src_size, use_native=False,
+                num_workers=threads,
+            ),
+        }
+        if native:
+            from moco_tpu.data.native_loader import NativeImageFolderDataset
+
+            modes["jpeg_native"] = NativeImageFolderDataset(
+                folder, decode_size=args.src_size, threads=threads
+            )
+            modes["cache_native"] = PackedRGBCacheDataset(
+                cache_dir, decode_size=args.src_size, use_native=True,
+                num_workers=threads,
+            )
+        modes["cache_canvas"] = PackedRGBCacheDataset(
+            cache_dir, decode_size=args.src_size, use_native=False,
+            num_workers=threads,
+        )
+        for name, ds in modes.items():
+            r = profile_mode(name, ds, args.batch, args.out_size, args.reps, pool)
+            r["threads"] = threads
+            r["imgs_per_sec"] = 1e3 * args.batch / r["total_ms"]
+            results.append(r)
+            print(
+                f"[threads={threads}] {name:13s} total {r['total_ms']:8.1f} ms/batch "
+                f"({r['imgs_per_sec']:7.1f} imgs/s) "
+                + " ".join(
+                    f"{k.replace('_ms','')}={v:.1f}"
+                    for k, v in r.items()
+                    if k.endswith("_ms") and k != "total_ms" and v is not None
+                ),
+                flush=True,
+            )
+        pool.shutdown()
+
+    # host->device transfer of one batch's fresh uint8 buffers (2 crops)
+    transfer = None
+    import jax
+
+    try:
+        dev = jax.devices()[0]
+        buf = np.random.default_rng(0).integers(
+            0, 255, (args.batch, args.out_size, args.out_size, 3), np.uint8
+        )
+        def put():
+            a = jax.device_put(buf.copy(), dev)  # fresh buffer: no cache
+            b = jax.device_put(buf.copy(), dev)
+            np.asarray(a[0, 0, 0]); np.asarray(b[0, 0, 0])  # sync via fetch
+        transfer = {
+            "platform": dev.platform,
+            "two_crop_put_ms": _time(put, args.reps),
+            "bytes": 2 * buf.nbytes,
+        }
+        transfer["mb_per_sec"] = (
+            transfer["bytes"] / 1e6 / (transfer["two_crop_put_ms"] / 1e3)
+        )
+        print(f"transfer: {transfer}")
+    except Exception as e:
+        print(f"transfer timing skipped: {e}", file=sys.stderr)
+
+    os.makedirs(os.path.dirname(args.artifact) or ".", exist_ok=True)
+    payload = {
+        "batch": args.batch, "out_size": args.out_size,
+        "src_size": args.src_size, "native_available": native,
+        "results": results, "transfer": transfer,
+    }
+    with open(args.artifact, "w") as f:
+        json.dump(payload, f, indent=2)
+    write_section(args.profile_md, payload)
+
+
+def write_section(profile_md: str, payload: dict) -> None:
+    rows = [r for r in payload["results"] if r["threads"] == 1]
+    by_threads: dict = {}
+    for r in payload["results"]:
+        by_threads.setdefault(r["mode"], {})[r["threads"]] = r["imgs_per_sec"]
+    lines = [
+        "## Input-path per-stage breakdown",
+        "",
+        f"`scripts/profile_input.py`: batch {payload['batch']}, two "
+        f"{payload['out_size']}px crops/image, {payload['src_size']}px synthetic "
+        "JPEGs, best-of-reps ms per batch, single thread (per-stage):",
+        "",
+        "| mode | dims | box sample | source read | crop+resize | total ms | imgs/s |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for r in rows:
+        cr = r.get("crop_resize_ms")
+        lines.append(
+            f"| {r['mode']} | {r['dims_ms']:.1f} | {r.get('boxes_ms', 0):.1f} | "
+            f"{r['read_ms'] if r['read_ms'] is not None else float('nan'):.1f} | "
+            f"{cr if cr is not None else 0:.1f} | "
+            f"{r['total_ms']:.1f} | {r['imgs_per_sec']:.0f} |"
+        )
+    lines += [
+        "",
+        "Thread scaling (imgs/s; flat on this 1-core host — the pools add",
+        "no overhead but there is no parallelism to harvest; both crop",
+        "backends run outside the GIL, so the 1-thread rate scales with",
+        "cores on real TPU-VM hosts):",
+        "",
+        "| mode | " + " | ".join(f"{t} thr" for t in sorted({r['threads'] for r in payload['results']})) + " |",
+        "|---|" + "---|" * len({r['threads'] for r in payload['results']}),
+    ]
+    for mode, per in by_threads.items():
+        lines.append(
+            f"| {mode} | " + " | ".join(f"{per[t]:.0f}" for t in sorted(per)) + " |"
+        )
+    t = payload.get("transfer")
+    if t:
+        lines += [
+            "",
+            f"Host→device transfer ({t['platform']}): {t['two_crop_put_ms']:.1f} ms "
+            f"for both crop buffers ({t['bytes'] / 1e6:.0f} MB) = "
+            f"{t['mb_per_sec']:.0f} MB/s.",
+        ]
+    section = "\n".join(lines)
+    block = f"{MARK_BEGIN}\n{section}\n{MARK_END}\n"
+    text = ""
+    if os.path.exists(profile_md):
+        with open(profile_md) as f:
+            text = f.read()
+    if MARK_BEGIN in text and MARK_END in text:
+        pre = text[: text.index(MARK_BEGIN)]
+        post = text[text.index(MARK_END) + len(MARK_END) :].lstrip("\n")
+        text = pre + block + post
+    else:
+        text = text.rstrip("\n") + "\n\n" + block if text else block
+    with open(profile_md, "w") as f:
+        f.write(text)
+    print(f"input-profile section written into {profile_md}")
+
+
+if __name__ == "__main__":
+    main()

@@ -32,6 +32,13 @@ import numpy as np
 
 def main() -> None:
     addr, pid, nproc = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    # checkpoint-continuity phases (VERDICT r2 #6): 'save' runs two
+    # steps, checkpoints the 2-process world via Orbax, then keeps going
+    # (its later losses are the uninterrupted-run oracle); 'restore' is a
+    # FRESH process pair that restores that checkpoint and continues —
+    # the parent asserts its losses equal the oracle bit-for-bit.
+    phase = sys.argv[4] if len(sys.argv) > 4 else "plain"
+    workdir = sys.argv[5] if len(sys.argv) > 5 else None
 
     from moco_tpu.parallel import initialize_multihost
 
@@ -87,11 +94,44 @@ def main() -> None:
         jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec()),
     )
 
-    losses = []
-    for _step, batch_dict in zip(range(2), pipe.epoch(0)):
-        state, metrics = step_fn(state, batch_dict, root_rng)
-        # loss is fully replicated -> addressable from every process
-        losses.append(float(jax.device_get(metrics["loss"])))
+    def run_steps(state, epoch: int, n: int):
+        losses = []
+        for _step, batch_dict in zip(range(n), pipe.epoch(epoch)):
+            state, metrics = step_fn(state, batch_dict, root_rng)
+            # loss is fully replicated -> addressable from every process
+            losses.append(float(jax.device_get(metrics["loss"])))
+        return state, losses
+
+    evidence = {}
+    if phase == "restore":
+        # fresh process pair: restore the 'save' phase's checkpoint into
+        # the freshly-initialized template, then continue epoch 1 exactly
+        # as the uninterrupted run did
+        from moco_tpu.utils.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(workdir)
+        state, extra = mgr.restore(state)
+        mgr.close()
+        state = place_state(state, mesh)
+        assert int(state.step) == 2, int(state.step)
+        evidence["restored_step"] = int(state.step)
+        evidence["restored_epoch"] = int(extra.get("epoch", -1))
+        state, losses = run_steps(state, epoch=1, n=2)
+        evidence["post_losses"] = losses
+    elif phase == "save":
+        state, losses = run_steps(state, epoch=0, n=2)
+        from moco_tpu.utils.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(workdir)
+        mgr.save(int(state.step), state, extra={"epoch": 0})
+        mgr.close()
+        # uninterrupted continuation: the oracle the restored pair must hit
+        state, post = run_steps(state, epoch=1, n=2)
+        evidence["pre_losses"] = losses
+        evidence["post_losses"] = post
+        losses = losses + post
+    else:
+        state, losses = run_steps(state, epoch=0, n=2)
 
     print(
         json.dumps(
@@ -105,6 +145,7 @@ def main() -> None:
                 "local_positions": np.asarray(part.local_positions).tolist(),
                 "losses": losses,
                 "final_step": int(state.step),
+                **evidence,
             }
         ),
         flush=True,

@@ -46,12 +46,16 @@ def _worker_env() -> dict:
     return env
 
 
-def test_two_process_world_trains_in_lockstep():
+def _run_pair(extra_args: list[str] | None = None) -> list[dict]:
+    """Spawn a 2-process world, drain both workers concurrently, return
+    their JSON evidence lines. Concurrent drain matters: a full stderr
+    pipe on one worker mid-collective would block its peer too, and a
+    sequential communicate() would read that as a spurious timeout."""
     addr = f"127.0.0.1:{_free_port()}"
     env = _worker_env()
     procs = [
         subprocess.Popen(
-            [sys.executable, WORKER, addr, str(pid), str(NPROC)],
+            [sys.executable, WORKER, addr, str(pid), str(NPROC), *(extra_args or [])],
             env=env,
             stdout=subprocess.PIPE,
             stderr=subprocess.PIPE,
@@ -60,9 +64,6 @@ def test_two_process_world_trains_in_lockstep():
         )
         for pid in range(NPROC)
     ]
-    # drain both workers CONCURRENTLY: a full stderr pipe on one worker
-    # mid-collective would block its peer too, and a sequential
-    # communicate() would then read that as a spurious timeout
     results: dict[int, tuple] = {}
 
     def drain(i, p):
@@ -90,7 +91,11 @@ def test_two_process_world_trains_in_lockstep():
             if p.poll() is None:
                 p.kill()
                 p.wait()
+    return outs
 
+
+def test_two_process_world_trains_in_lockstep():
+    outs = _run_pair()
     by_pid = {o["process"]: o for o in outs}
     assert set(by_pid) == {0, 1}
     for o in outs:
@@ -112,3 +117,31 @@ def test_two_process_world_trains_in_lockstep():
     # replicated lockstep: the SPMD program is identical on both
     # processes, so the replicated loss must match bit-for-bit
     assert by_pid[0]["losses"] == by_pid[1]["losses"]
+
+
+def test_checkpoint_restore_continuity_across_restart(tmp_path):
+    """The reference's recovery story is manual `--resume` from the last
+    checkpoint (`main_moco.py:~L195-215`). The multi-host equivalent:
+    a 2-process world saves mid-run via Orbax, BOTH processes restart
+    (a fresh rendezvous), restore, and continue — and the continuation
+    must be bit-identical to the run that never stopped (params, opt
+    state, queue+ptr, EMA encoder, and the step counter that seeds the
+    per-step shuffle RNG all round-tripped exactly), on both processes.
+    """
+    workdir = str(tmp_path / "ckpt")
+    saved = _run_pair(["save", workdir])
+    by_pid = {o["process"]: o for o in saved}
+    assert by_pid[0]["post_losses"] == by_pid[1]["post_losses"]
+    oracle = by_pid[0]["post_losses"]  # uninterrupted continuation
+    assert by_pid[0]["final_step"] == 4
+
+    restored = _run_pair(["restore", workdir])
+    r_by_pid = {o["process"]: o for o in restored}
+    for o in restored:
+        assert o["restored_step"] == 2
+        assert o["restored_epoch"] == 0
+        assert o["final_step"] == 4
+    # lockstep across the restarted processes...
+    assert r_by_pid[0]["post_losses"] == r_by_pid[1]["post_losses"]
+    # ...and bit-identical to the run that never restarted
+    assert r_by_pid[0]["post_losses"] == oracle

@@ -83,3 +83,122 @@ def test_linear_classifier_init():
     k = v["params"]["Dense_0"]["kernel"]
     assert np.abs(k).std() < 0.02 and not np.allclose(k, 0)
     assert np.allclose(v["params"]["Dense_0"]["bias"], 0)
+
+
+class TestSubsetStatsBatchNorm:
+    """The byte-reduction BN (PROFILE.md lever): statistics from the
+    first `stats_rows` rows, normalization over all rows, tree paths
+    identical to nn.BatchNorm so checkpoints interchange."""
+
+    def _mods(self, stats_rows):
+        import flax.linen as nn
+
+        from moco_tpu.models.resnet import BatchNorm
+
+        ours = BatchNorm(stats_rows=stats_rows, use_running_average=False)
+        ref = nn.BatchNorm(use_running_average=False, momentum=0.9, epsilon=1e-5)
+        return ours, ref
+
+    def test_full_batch_matches_flax_batchnorm(self):
+        ours, ref = self._mods(stats_rows=0)
+        x = jax.random.normal(jax.random.PRNGKey(0), (8, 4, 4, 6))
+        vo = ours.init(jax.random.PRNGKey(1), x)
+        vr = ref.init(jax.random.PRNGKey(1), x)
+        yo, mo = ours.apply(vo, x, mutable=["batch_stats"])
+        yr, mr = ref.apply(vr, x, mutable=["batch_stats"])
+        np.testing.assert_allclose(np.asarray(yo), np.asarray(yr), atol=1e-5)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5),
+            mo["batch_stats"], mr["batch_stats"],
+        )
+
+    def test_subset_stats_are_first_rows_only(self):
+        ours, _ = self._mods(stats_rows=4)
+        x = jax.random.normal(jax.random.PRNGKey(0), (16, 3, 3, 5))
+        v = ours.init(jax.random.PRNGKey(1), x)
+        y, mut = ours.apply(v, x, mutable=["batch_stats"])
+        sub = np.asarray(x[:4], np.float64)
+        mean = sub.mean(axis=(0, 1, 2))
+        var = (sub**2).mean(axis=(0, 1, 2)) - mean**2
+        # normalization over ALL rows with the subset statistics
+        expect = (np.asarray(x, np.float64) - mean) / np.sqrt(var + 1e-5)
+        np.testing.assert_allclose(np.asarray(y), expect, atol=1e-4)
+        # perturbing rows OUTSIDE the subset must not change the stats
+        x2 = x.at[8:].add(3.0)
+        y2, mut2 = ours.apply(v, x2, mutable=["batch_stats"])
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, atol=0),
+            mut["batch_stats"], mut2["batch_stats"],
+        )
+        np.testing.assert_allclose(np.asarray(y2[:8]), np.asarray(y[:8]), atol=1e-6)
+
+    def test_running_stats_update_and_eval_mode(self):
+        from moco_tpu.models.resnet import BatchNorm
+
+        x = jax.random.normal(jax.random.PRNGKey(0), (16, 3, 3, 5)) * 2 + 1
+        bn = BatchNorm(stats_rows=4, use_running_average=False, momentum=0.5)
+        v = bn.init(jax.random.PRNGKey(1), x)
+        _, mut = bn.apply(v, x, mutable=["batch_stats"])
+        sub = np.asarray(x[:4], np.float64)
+        mean = sub.mean(axis=(0, 1, 2))
+        var = (sub**2).mean(axis=(0, 1, 2)) - mean**2
+        np.testing.assert_allclose(
+            np.asarray(mut["batch_stats"]["mean"]), 0.5 * mean, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(mut["batch_stats"]["var"]), 0.5 + 0.5 * var, atol=1e-5
+        )
+        ev = BatchNorm(stats_rows=4, use_running_average=True)
+        y = ev.apply({"params": v["params"], "batch_stats": mut["batch_stats"]}, x)
+        m = np.asarray(mut["batch_stats"]["mean"])
+        s = np.sqrt(np.asarray(mut["batch_stats"]["var"]) + 1e-5)
+        np.testing.assert_allclose(np.asarray(y), (np.asarray(x) - m) / s, atol=1e-4)
+
+    def test_resnet_tree_paths_identical_across_modes(self):
+        full = create_resnet("resnet18", cifar_stem=True)
+        sub = create_resnet("resnet18", cifar_stem=True, bn_stats_rows=4)
+        x = jnp.zeros((8, 32, 32, 3))
+        vf = full.init(jax.random.PRNGKey(0), x, train=True)
+        vs = sub.init(jax.random.PRNGKey(0), x, train=True)
+        assert jax.tree_util.tree_structure(vf) == jax.tree_util.tree_structure(vs)
+        # same init values too: a checkpoint from either mode loads in the other
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, atol=0), vf, vs
+        )
+
+    def test_train_step_runs_with_subset_bn(self):
+        from moco_tpu.core import build_encoder, create_state, make_train_step, place_state
+        from moco_tpu.parallel import create_mesh
+        from moco_tpu.utils.config import (
+            DataConfig, MocoConfig, OptimConfig, TrainConfig,
+        )
+        from moco_tpu.utils.schedules import build_optimizer
+
+        cfg = TrainConfig(
+            moco=MocoConfig(
+                arch="resnet18", dim=16, num_negatives=64, mlp=True,
+                shuffle="gather_perm", cifar_stem=True, compute_dtype="float32",
+                bn_stats_rows=2,
+            ),
+            optim=OptimConfig(lr=0.03, epochs=1),
+            data=DataConfig(dataset="synthetic", image_size=32, global_batch=16),
+        )
+        mesh = create_mesh()
+        n = mesh.shape["data"]
+        enc = build_encoder(cfg.moco, num_data=n)
+        tx = build_optimizer(cfg.optim, steps_per_epoch=2)
+        state = create_state(
+            jax.random.PRNGKey(0), cfg, enc, tx, jnp.zeros((1, 32, 32, 3))
+        )
+        state = place_state(state, mesh)
+        step = make_train_step(cfg, enc, tx, mesh)
+        batch = {
+            "im_q": jnp.zeros((16, 32, 32, 3), jnp.uint8),
+            "im_k": jnp.zeros((16, 32, 32, 3), jnp.uint8),
+        }
+        rng = jax.device_put(
+            jax.random.PRNGKey(2),
+            jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec()),
+        )
+        state, metrics = step(state, batch, rng)
+        assert np.isfinite(float(metrics["loss"]))

@@ -45,6 +45,12 @@ def build_parser() -> argparse.ArgumentParser:
         default=None,
         help="BN-decorrelation strategy (reference Shuffle-BN == gather_perm)",
     )
+    p.add_argument(
+        "--bn-stats-rows", type=int, default=None,
+        help="BN training statistics from the first N rows per device "
+        "(0 = full batch); byte-reduction lever matching the reference's "
+        "32-row per-GPU statistics granularity",
+    )
     # ViT options (moco-v3 family)
     p.add_argument(
         "--v3", action="store_true", default=None,
@@ -101,6 +107,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="overlap checkpoint writes with training (Orbax async); the "
         "preemption save still blocks until durable",
     )
+    p.add_argument(
+        "--keep", type=int, default=None,
+        help="retain the last N checkpoints (default 3); 0 keeps every "
+        "one — the reference's per-epoch retention (main_moco.py:~L275-280)",
+    )
     # parallel / infra
     p.add_argument("--num-data", type=int, default=None, help="data-axis size (default: all devices)")
     p.add_argument("--num-model", type=int, default=None, help="model-axis size (shards the queue)")
@@ -132,6 +143,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         temperature=args.moco_t,
         mlp=args.mlp,
         shuffle=args.shuffle,
+        bn_stats_rows=args.bn_stats_rows,
         v3=args.v3,
         momentum_cos=args.moco_m_cos,
         vit_pool=args.vit_pool,
@@ -175,6 +187,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         steps_per_epoch=args.steps_per_epoch,
         knn_every_epochs=args.knn_every_epochs,
         checkpoint_async=args.checkpoint_async,
+        checkpoint_keep=args.keep,
     )
 
 
