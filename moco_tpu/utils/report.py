@@ -1,0 +1,41 @@
+"""Marker-delimited report sections.
+
+The evidence scripts (`scripts/learning_signal.py`,
+`scripts/ablate_shuffle.py`, `scripts/profile_input.py`) each own one
+`<!-- name:begin -->…<!-- name:end -->` block of REPORT.md / PROFILE.md
+and must be re-runnable without clobbering each other's sections.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+
+def replace_marker_block(path: str, name: str, section: str) -> None:
+    """Insert or replace the `name`-delimited block in `path`, preserving
+    everything else (creates the file if missing)."""
+    begin, end = f"<!-- {name}:begin -->", f"<!-- {name}:end -->"
+    block = f"{begin}\n{section}\n{end}\n"
+    text = ""
+    if os.path.exists(path):
+        with open(path) as f:
+            text = f.read()
+    if begin in text and end in text:
+        pre = text[: text.index(begin)]
+        post = text[text.index(end) + len(end) :].lstrip("\n")
+        text = pre + block + post
+    else:
+        text = text.rstrip("\n") + "\n\n" + block if text else block
+    with open(path, "w") as f:
+        f.write(text)
+
+
+def extract_marker_blocks(text: str) -> list[str]:
+    """All marker-delimited blocks in `text`, in order — used when a
+    tool regenerates a report body and must carry the other tools'
+    sections across."""
+    return [
+        m.group(0)
+        for m in re.finditer(r"<!-- ([\w-]+):begin -->.*?<!-- \1:end -->", text, re.S)
+    ]

@@ -51,8 +51,6 @@ from moco_tpu.utils.platform import pin_platform_from_env
 pin_platform_from_env()
 
 ABLATION_DIR = "artifacts/ablation"
-MARK_BEGIN = "<!-- ablation:begin -->"
-MARK_END = "<!-- ablation:end -->"
 
 ARMS = ("none", "gather_perm", "a2a", "syncbn", "m0")
 
@@ -212,19 +210,9 @@ def write_into_report(report_path: str = "REPORT.md", ablation_dir: str = ABLATI
     section = render_section(ablation_dir)
     if section is None:
         return
-    block = f"{MARK_BEGIN}\n{section}\n{MARK_END}\n"
-    text = ""
-    if os.path.exists(report_path):
-        with open(report_path) as f:
-            text = f.read()
-    if MARK_BEGIN in text and MARK_END in text:
-        pre = text[: text.index(MARK_BEGIN)]
-        post = text[text.index(MARK_END) + len(MARK_END) :].lstrip("\n")
-        text = pre + block + post
-    else:
-        text = text.rstrip("\n") + "\n\n" + block if text else block
-    with open(report_path, "w") as f:
-        f.write(text)
+    from moco_tpu.utils.report import replace_marker_block
+
+    replace_marker_block(report_path, "ablation", section)
     print(f"ablation section written into {report_path}")
 
 

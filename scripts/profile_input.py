@@ -43,8 +43,6 @@ pin_platform_from_env()
 
 import numpy as np
 
-MARK_BEGIN = "<!-- input-profile:begin -->"
-MARK_END = "<!-- input-profile:end -->"
 ART_PATH = "artifacts/input_profile.json"
 
 
@@ -271,20 +269,9 @@ def write_section(profile_md: str, payload: dict) -> None:
             f"for both crop buffers ({t['bytes'] / 1e6:.0f} MB) = "
             f"{t['mb_per_sec']:.0f} MB/s.",
         ]
-    section = "\n".join(lines)
-    block = f"{MARK_BEGIN}\n{section}\n{MARK_END}\n"
-    text = ""
-    if os.path.exists(profile_md):
-        with open(profile_md) as f:
-            text = f.read()
-    if MARK_BEGIN in text and MARK_END in text:
-        pre = text[: text.index(MARK_BEGIN)]
-        post = text[text.index(MARK_END) + len(MARK_END) :].lstrip("\n")
-        text = pre + block + post
-    else:
-        text = text.rstrip("\n") + "\n\n" + block if text else block
-    with open(profile_md, "w") as f:
-        f.write(text)
+    from moco_tpu.utils.report import replace_marker_block
+
+    replace_marker_block(profile_md, "input-profile", "\n".join(lines))
     print(f"input-profile section written into {profile_md}")
 
 
