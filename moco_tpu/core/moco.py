@@ -72,10 +72,12 @@ def create_backbone(cfg: MocoConfig, num_data: Optional[int] = None) -> nn.Modul
         # a ResNet would double backbone grads over the model axis
         raise ValueError(f"vit_sequence_parallel requires a ViT arch, got {cfg.arch!r}")
     if cfg.arch.startswith("vit"):
-        if cfg.bn_stats_rows:
+        if cfg.bn_stats_rows or cfg.bn_virtual_groups > 1:
             # must fail loudly: a ViT has no BatchNorm, the lever would be
             # inert while the checkpoint config records it as active
-            raise ValueError("bn_stats_rows applies to ResNet BatchNorm, not ViT archs")
+            raise ValueError(
+                "bn_stats_rows / bn_virtual_groups apply to ResNet BatchNorm, not ViT archs"
+            )
         from moco_tpu.models.vit import create_vit
 
         vit_kw = {"patch_size": cfg.vit_patch_size} if cfg.vit_patch_size else {}
@@ -106,6 +108,8 @@ def create_backbone(cfg: MocoConfig, num_data: Optional[int] = None) -> nn.Modul
         if num_data % g:
             raise ValueError(f"data axis {num_data} not divisible by syncbn group {g}")
         groups = [list(range(i, i + g)) for i in range(0, num_data, g)]
+    if cfg.bn_virtual_groups > 1 and cfg.shuffle == "syncbn":
+        raise ValueError("bn_virtual_groups does not compose with syncbn")
     return create_resnet(
         cfg.arch,
         cifar_stem=cfg.cifar_stem,
@@ -113,6 +117,7 @@ def create_backbone(cfg: MocoConfig, num_data: Optional[int] = None) -> nn.Modul
         bn_cross_replica_axis=syncbn_axis,
         bn_axis_index_groups=groups,
         bn_stats_rows=cfg.bn_stats_rows,
+        bn_virtual_groups=cfg.bn_virtual_groups,
     )
 
 
@@ -481,14 +486,20 @@ def make_train_step(
         params_k = ema_update(state.params_k, state.params_q, ema_momentum(state.step))
 
         # (2) Shuffle-BN: compute keys on a batch that contains none of
-        # this device's own positives.
-        if cfg.shuffle == "gather_perm" and n_data > 1:
+        # this device's own positives. With bn_virtual_groups the same
+        # permutation machinery runs even on ONE device (all_gather over
+        # a size-1 axis is the identity, so gather_perm degrades to a
+        # pure in-batch permutation): per-group BN statistics + permuted
+        # group composition = the reference's G-GPU Shuffle-BN inside a
+        # single chip's batch.
+        shuffle_active = n_data > 1 or cfg.bn_virtual_groups > 1
+        if cfg.shuffle == "gather_perm" and shuffle_active:
             perm, inv_perm = make_permutation(step_rng, global_batch)
             im_k_sh = shuffle_gather(im_k, perm, DATA_AXIS)
             k_sh, stats_k = apply_encoder(params_k, state.batch_stats_k, im_k_sh)
             k_sh = l2_normalize(k_sh)
             k_local, k_global = unshuffle_gather(k_sh, inv_perm, DATA_AXIS)
-        elif cfg.shuffle == "a2a" and n_data > 1:
+        elif cfg.shuffle == "a2a" and shuffle_active:
             im_k_sh = balanced_shuffle(step_rng, im_k, DATA_AXIS)
             k_sh, stats_k = apply_encoder(params_k, state.batch_stats_k, im_k_sh)
             k_sh = l2_normalize(k_sh)

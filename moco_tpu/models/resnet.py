@@ -34,27 +34,37 @@ conv_kernel_init = nn.initializers.variance_scaling(2.0, "fan_out", "truncated_n
 
 
 class BatchNorm(nn.Module):
-    """`nn.BatchNorm`-compatible BN whose training statistics come from a
-    SUBSET of the batch rows (`stats_rows` per device; 0 = full batch).
+    """`nn.BatchNorm`-compatible BN with two training-statistics modes
+    beyond the full batch:
 
-    The byte-reduction lever for the BN-bound step (PROFILE.md: the BN
-    statistics reductions are 55% of step time — each training BN
-    re-reads its full activation tensor over and above the conv that
-    produced it). With `stats_rows=r`, the forward statistics passes read
-    only `r/B` of each activation. Statistically this is FAITHFUL to the
-    reference's granularity: upstream trains with per-GPU BatchNorm over
-    batch-256/8-GPUs = 32-row statistics (`main_moco.py:~L172`, DDP
-    per-rank batch), while a 256-row single-chip batch otherwise uses 8x
-    more samples per estimate than the recipe ever did.
+    - `stats_rows=r` — statistics from the first r rows only. The
+      byte-reduction lever for the BN-bound step (PROFILE.md: the BN
+      statistics reductions are 55% of step time — each training BN
+      re-reads its full activation tensor over and above the conv that
+      produced it); the forward statistics passes then read only r/B of
+      each activation. Normalization still covers ALL rows.
+    - `virtual_groups=G` — per-group statistics over G contiguous
+      row-groups, each group normalized with its own statistics: the
+      reference's per-GPU BatchNorm semantics (`main_moco.py:~L172`,
+      batch 256 over 8 DDP ranks = 32-row statistics) reproduced inside
+      ONE device's batch. Composed with the in-batch key permutation
+      this makes single-chip training Shuffle-BN-faithful — a G-GPU
+      recipe on one TPU. Same bytes as full BN (every row is read);
+      running statistics are the group average, matching the train
+      step's cross-device `pmean` of per-device stats.
 
-    Parameter/variable names and tree paths match `nn.BatchNorm`
-    (class name included), so checkpoints interchange between the modes.
-    Normalization covers ALL rows; gradients flow through the subset
-    statistics exactly as they do through full-batch statistics.
-    `axis_name` composes the subset statistics cross-replica (SyncBN).
+    Both modes are faithful to the reference's statistics granularity
+    rather than the 8x-larger single-chip batch. Parameter/variable
+    names and tree paths match `nn.BatchNorm` (class name included), so
+    checkpoints interchange between all modes. Gradients flow through
+    the statistics exactly as through full-batch statistics.
+    `axis_name` composes subset statistics cross-replica (SyncBN); it is
+    rejected with virtual_groups (subgrouped SyncBN already covers the
+    cross-device grouping pattern).
     """
 
     stats_rows: int = 0
+    virtual_groups: int = 0
     use_running_average: bool = False
     momentum: float = 0.9
     epsilon: float = 1e-5
@@ -77,8 +87,41 @@ class BatchNorm(nn.Module):
         )
         if self.stats_rows < 0:
             raise ValueError(f"stats_rows must be >= 0, got {self.stats_rows}")
+        if self.virtual_groups < 0:
+            raise ValueError(f"virtual_groups must be >= 0, got {self.virtual_groups}")
+        if self.stats_rows and self.virtual_groups > 1:
+            raise ValueError("stats_rows and virtual_groups are mutually exclusive")
+        if self.virtual_groups > 1 and self.axis_name is not None:
+            raise ValueError("virtual_groups does not compose with cross-replica BN")
         if self.use_running_average:
             mean, var = ra_mean.value, ra_var.value
+        elif self.virtual_groups > 1:
+            g = self.virtual_groups
+            b = x.shape[0]
+            if b % g:
+                raise ValueError(f"batch {b} not divisible by virtual_groups {g}")
+            xg = x.reshape((g, b // g) + x.shape[1:]).astype(jnp.float32)
+            axes = tuple(range(1, xg.ndim - 1))  # all but group + channel
+            mean = jnp.mean(xg, axis=axes)  # (g, C)
+            mean2 = jnp.mean(jnp.square(xg), axis=axes)
+            var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
+            if not self.is_initializing():
+                ra_mean.value = (
+                    self.momentum * ra_mean.value + (1 - self.momentum) * mean.mean(0)
+                )
+                ra_var.value = (
+                    self.momentum * ra_var.value + (1 - self.momentum) * var.mean(0)
+                )
+            mul = scale * jax.lax.rsqrt(var + self.epsilon)  # (g, C)
+            shift = bias - mean * mul
+            bcast = (g,) + (1,) * (xg.ndim - 2) + (feats,)
+            # normalize in the input dtype (xg's f32 copy was for the
+            # statistics only): a f32 return here would silently switch
+            # every downstream conv out of bf16
+            y = x.reshape(xg.shape) * mul.reshape(bcast).astype(self.dtype) + shift.reshape(
+                bcast
+            ).astype(self.dtype)
+            return y.reshape(x.shape)
         else:
             rows = x.shape[0]
             if self.stats_rows and self.stats_rows < rows:
@@ -187,6 +230,9 @@ class ResNet(nn.Module):
     # Training BN statistics from the first N rows of the (per-device)
     # batch; 0 = full batch (exact nn.BatchNorm). See BatchNorm above.
     bn_stats_rows: int = 0
+    # Per-group statistics over G contiguous row-groups (the reference's
+    # per-GPU BN inside one device's batch). See BatchNorm above.
+    bn_virtual_groups: int = 0
 
     @property
     def num_features(self) -> int:
@@ -194,8 +240,13 @@ class ResNet(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        norm_cls = BatchNorm if self.bn_stats_rows else nn.BatchNorm
-        extra = {"stats_rows": self.bn_stats_rows} if self.bn_stats_rows else {}
+        custom = self.bn_stats_rows or self.bn_virtual_groups > 1
+        norm_cls = BatchNorm if custom else nn.BatchNorm
+        extra = (
+            {"stats_rows": self.bn_stats_rows, "virtual_groups": self.bn_virtual_groups}
+            if custom
+            else {}
+        )
         norm = functools.partial(
             norm_cls,
             use_running_average=not train,

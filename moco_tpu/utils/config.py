@@ -36,6 +36,12 @@ class MocoConfig:
     # the reference's statistics granularity — upstream's per-GPU BN
     # estimates from 32 rows (batch 256 / 8 GPUs, main_moco.py:~L172).
     bn_stats_rows: int = 0
+    # Virtual Shuffle-BN on few devices: per-group BN statistics over G
+    # contiguous row-groups of each device's batch (the reference's
+    # per-GPU BN semantics inside one chip), and the key batch is
+    # permuted in-batch even on a single device so group composition
+    # decorrelates — a G-GPU recipe on one TPU. 0 = off.
+    bn_virtual_groups: int = 0
     cifar_stem: bool = False
     compute_dtype: str = "bfloat16"
     # MoCo v3 (queue-free symmetric contrastive): set num_negatives=0,

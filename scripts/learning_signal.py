@@ -222,16 +222,22 @@ def _knn_rows(workdir: str) -> tuple[list, list, list]:
     return losses, accs, knns
 
 
+def _final_knn(knns: list, summary: dict) -> float:
+    """Last kNN-monitor value, falling back to the summary then nan
+    (shared by both report writers so they can't disagree)."""
+    if knns:
+        return knns[-1][1]
+    s = summary.get("final_knn")
+    return s if s is not None else float("nan")
+
+
 def write_v3_section(workdir: str, report_path: str, summary: dict) -> None:
     """v3 learning-signal section (marker-delimited) appended to
     REPORT.md — evidence for the queue-free symmetric recipe."""
     losses, accs, knns = _knn_rows(workdir)
     chance = 100.0 / summary["num_classes"]
     probe = summary["probe_metrics"]
-    summary_knn = summary.get("final_knn")
-    final_knn = (
-        knns[-1][1] if knns else (summary_knn if summary_knn is not None else float("nan"))
-    )
+    final_knn = _final_knn(knns, summary)
     lines = [
         "## MoCo v3 (queue-free symmetric, ViT) learning signal",
         "",
@@ -245,9 +251,9 @@ def write_v3_section(workdir: str, report_path: str, summary: dict) -> None:
         "| Metric | Value | Reference point |",
         "|---|---|---|",
         f"| symmetric InfoNCE loss, last | {losses[-1][1]:.3f} | down from "
-        f"{losses[0][1]:.3f} at step {losses[0][0]} |" if losses else "",
+        f"{losses[0][1]:.3f} at step {losses[0][0]} |" if losses else None,
         f"| contrast acc@1, last | {accs[-1][1]:.2f}% | positives vs "
-        "in-batch negatives |" if accs else "",
+        "in-batch negatives |" if accs else None,
         f"| **kNN top-1 (frozen features)** | **{final_knn:.2f}%** | {chance:.1f}% chance |",
         f"| **linear-probe top-1** | **{probe['acc1']:.2f}%** | {chance:.1f}% chance |",
         f"| raw-pixel kNN top-1 (baseline) | {summary['pixel_top1']:.2f}% | {chance:.1f}% chance |",
@@ -277,10 +283,7 @@ def write_report(workdir: str, report_path: str, summary: dict) -> None:
     contrast_chance = 100.0 / (1 + k)
     random_loss = math.log(1 + k)  # CE of uniform guessing over (K+1) ways
     probe_metrics = summary["probe_metrics"]
-    summary_knn = summary.get("final_knn")
-    final_knn = (
-        knns[-1][1] if knns else (summary_knn if summary_knn is not None else float("nan"))
-    )
+    final_knn = _final_knn(knns, summary)
     ds_name = summary.get("dataset", "synthetic_learnable")
     if ds_name == "synthetic_hard":
         ds_lines = [

@@ -202,3 +202,94 @@ class TestSubsetStatsBatchNorm:
         )
         state, metrics = step(state, batch, rng)
         assert np.isfinite(float(metrics["loss"]))
+
+
+class TestVirtualGroupBatchNorm:
+    """bn_virtual_groups: the reference's per-GPU BN inside one device's
+    batch (grouped statistics + in-batch key permutation)."""
+
+    def test_grouped_stats_match_manual(self):
+        from moco_tpu.models.resnet import BatchNorm
+
+        x = jax.random.normal(jax.random.PRNGKey(0), (8, 3, 3, 5)) * 2 + 1
+        bn = BatchNorm(virtual_groups=4, use_running_average=False, momentum=0.5)
+        v = bn.init(jax.random.PRNGKey(1), x)
+        y, mut = bn.apply(v, x, mutable=["batch_stats"])
+        xg = np.asarray(x, np.float64).reshape(4, 2, 3, 3, 5)
+        mean = xg.mean(axis=(1, 2, 3))  # (4, 5)
+        var = (xg**2).mean(axis=(1, 2, 3)) - mean**2
+        expect = (xg - mean[:, None, None, None]) / np.sqrt(
+            var[:, None, None, None] + 1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(y), expect.reshape(8, 3, 3, 5), atol=1e-4
+        )
+        # running stats = group average (matching the step's pmean)
+        np.testing.assert_allclose(
+            np.asarray(mut["batch_stats"]["mean"]), 0.5 * mean.mean(0), atol=1e-5
+        )
+
+    def test_virtual_groups_equal_multi_device_shuffle_bn(self):
+        """The oracle: ONE device with bn_virtual_groups=G must produce
+        the same training program as G devices with per-device BN and
+        gather_perm Shuffle-BN — identical global permutation, identical
+        group composition, identical statistics."""
+        from moco_tpu.core import build_encoder, create_state, make_train_step, place_state
+        from moco_tpu.parallel import create_mesh, shard_batch
+        from moco_tpu.utils.config import (
+            DataConfig, MocoConfig, OptimConfig, ParallelConfig, TrainConfig,
+        )
+        from moco_tpu.utils.schedules import build_optimizer
+
+        batch, img, groups = 16, 32, 8
+
+        def run(num_data, virtual):
+            cfg = TrainConfig(
+                moco=MocoConfig(
+                    arch="resnet18", dim=16, num_negatives=64, mlp=True,
+                    shuffle="gather_perm", cifar_stem=True,
+                    compute_dtype="float32",
+                    bn_virtual_groups=virtual,
+                ),
+                optim=OptimConfig(lr=0.03, epochs=1),
+                data=DataConfig(dataset="synthetic", image_size=img, global_batch=batch),
+                parallel=ParallelConfig(num_data=num_data),
+            )
+            mesh = create_mesh(num_data=num_data)
+            enc = build_encoder(cfg.moco, num_data=num_data)
+            tx = build_optimizer(cfg.optim, steps_per_epoch=2)
+            state = create_state(
+                jax.random.PRNGKey(0), cfg, enc, tx, jnp.zeros((1, img, img, 3))
+            )
+            state = place_state(state, mesh)
+            step = make_train_step(cfg, enc, tx, mesh)
+            ims = jax.random.uniform(
+                jax.random.PRNGKey(7), (2, batch, img, img, 3)
+            )
+            b = shard_batch(
+                mesh,
+                {
+                    "im_q": (ims[0] * 255).astype(jnp.uint8),
+                    "im_k": (ims[1] * 255).astype(jnp.uint8),
+                },
+            )
+            rng = jax.device_put(
+                jax.random.PRNGKey(2),
+                jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec()),
+            )
+            losses = []
+            for _ in range(2):
+                state, metrics = step(state, b, rng)
+                losses.append(float(metrics["loss"]))
+            return losses, state
+
+        losses_multi, state_multi = run(num_data=groups, virtual=0)
+        losses_virtual, state_virtual = run(num_data=1, virtual=groups)
+        np.testing.assert_allclose(losses_multi, losses_virtual, rtol=2e-4)
+        # the updated BN running stats agree too (pmean over devices ==
+        # group-average inside the virtual batch)
+        stats_m = jax.tree.map(np.asarray, jax.device_get(state_multi.batch_stats_k))
+        stats_v = jax.tree.map(np.asarray, jax.device_get(state_virtual.batch_stats_k))
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, atol=1e-4), stats_m, stats_v
+        )

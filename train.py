@@ -51,6 +51,11 @@ def build_parser() -> argparse.ArgumentParser:
         "(0 = full batch); byte-reduction lever matching the reference's "
         "32-row per-GPU statistics granularity",
     )
+    p.add_argument(
+        "--bn-virtual-groups", type=int, default=None,
+        help="virtual Shuffle-BN: per-group BN statistics over G row-groups "
+        "+ in-batch key permutation — the reference's G-GPU recipe on one chip",
+    )
     # ViT options (moco-v3 family)
     p.add_argument(
         "--v3", action="store_true", default=None,
@@ -144,6 +149,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         mlp=args.mlp,
         shuffle=args.shuffle,
         bn_stats_rows=args.bn_stats_rows,
+        bn_virtual_groups=args.bn_virtual_groups,
         v3=args.v3,
         momentum_cos=args.moco_m_cos,
         vit_pool=args.vit_pool,
