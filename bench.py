@@ -115,7 +115,13 @@ def main() -> None:
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
 
-    from moco_tpu.core import build_encoder, create_state, make_train_step, place_state
+    from moco_tpu.core import (
+        build_encoder,
+        build_predictor,
+        create_state,
+        make_train_step,
+        place_state,
+    )
     from moco_tpu.parallel import create_mesh, shard_batch
     from moco_tpu.utils.config import DataConfig, MocoConfig, OptimConfig, TrainConfig
     from moco_tpu.utils.schedules import build_optimizer
@@ -124,36 +130,79 @@ def main() -> None:
         arch, img, batch, k, steps, dtype = "resnet50", 224, 256, 65536, 20, "bfloat16"
     else:  # CPU fallback so the bench always emits a line
         arch, img, batch, k, steps, dtype = "resnet18", 32, 64, 4096, 3, "float32"
+    # BENCH_ARCH=vit_b16 benches the v3 ViT step instead (queue-free
+    # symmetric loss, AdamW; BENCH_FLASH=1 adds the Pallas flash kernel)
+    arch = os.environ.get("BENCH_ARCH", arch)
+    is_vit = arch.startswith("vit")
     batch = int(os.environ.get("BENCH_BATCH", batch))
     steps = int(os.environ.get("BENCH_STEPS", steps))
 
     n_dev = len(jax.devices())
     mesh = create_mesh(num_data=n_dev, num_model=1)
-    config = TrainConfig(
-        moco=MocoConfig(
+    if is_vit:
+        moco = MocoConfig(
+            arch=arch,
+            dim=256,
+            num_negatives=0,
+            momentum=0.99,
+            momentum_cos=True,
+            temperature=0.2,
+            v3=True,
+            shuffle="none",
+            compute_dtype=dtype,
+            vit_flash_attention=os.environ.get("BENCH_FLASH", "0") == "1",
+        )
+        optim = OptimConfig(optimizer="adamw", lr=2.4e-3, weight_decay=0.1,
+                            epochs=300, cos=True, warmup_epochs=40)
+    else:
+        moco = MocoConfig(
             arch=arch,
             dim=128,
             num_negatives=k,
             temperature=0.2,
             mlp=True,
-            shuffle="gather_perm" if n_dev > 1 else "none",
+            # virtual groups need the in-batch key permutation, so the
+            # single-device bench switches to gather_perm when the
+            # BENCH_BN_VIRTUAL_GROUPS A/B leg is active
+            shuffle="gather_perm"
+            if n_dev > 1 or int(os.environ.get("BENCH_BN_VIRTUAL_GROUPS", 0)) > 1
+            else "none",
             cifar_stem=not on_tpu,
             compute_dtype=dtype,
             # BENCH_BN_STATS_ROWS=32 A/Bs the subset-statistics BN (the
-            # PROFILE.md byte-reduction lever) without code changes
+            # PROFILE.md byte-reduction lever); BENCH_BN_VIRTUAL_GROUPS=8
+            # the virtual Shuffle-BN mode — both without code changes
             bn_stats_rows=int(os.environ.get("BENCH_BN_STATS_ROWS", 0)),
-        ),
-        optim=OptimConfig(lr=0.03, epochs=200, cos=True),
+            bn_virtual_groups=int(os.environ.get("BENCH_BN_VIRTUAL_GROUPS", 0)),
+            # BENCH_FUSED=0/1 pins the streaming Pallas InfoNCE off/on
+            # (unset = the config's auto default) for the fused-vs-dense A/B
+            fused_infonce=(
+                None
+                if os.environ.get("BENCH_FUSED") is None
+                else os.environ["BENCH_FUSED"] == "1"
+            ),
+        )
+        optim = OptimConfig(lr=0.03, epochs=200, cos=True)
+    config = TrainConfig(
+        moco=moco,
+        optim=optim,
         data=DataConfig(dataset="synthetic", image_size=img, global_batch=batch),
     )
     encoder = build_encoder(config.moco, num_data=n_dev)
+    predictor = build_predictor(config.moco, num_data=n_dev)
     tx = build_optimizer(config.optim, steps_per_epoch=5004)
     rng = jax.random.PRNGKey(0)
-    state = create_state(rng, config, encoder, tx, jnp.zeros((1, img, img, 3), jnp.float32))
+    state = create_state(
+        rng, config, encoder, tx, jnp.zeros((1, img, img, 3), jnp.float32),
+        predictor=predictor,
+    )
     state = place_state(state, mesh)
     # donate=False: donation costs ~80ms/call through the axon remote-TPU
     # tunnel (measured, see make_train_step) and state is small vs HBM.
-    step = make_train_step(config, encoder, tx, mesh, donate=False)
+    step = make_train_step(
+        config, encoder, tx, mesh, donate=False, predictor=predictor,
+        total_steps=5004 * config.optim.epochs,
+    )
 
     ims = jax.random.normal(jax.random.PRNGKey(1), (2, batch, img, img, 3), jnp.float32)
     batch_dict = shard_batch(mesh, {"im_q": ims[0], "im_k": ims[1]})
@@ -192,10 +241,14 @@ def main() -> None:
 
     # ---- MFU (per-device FLOPs over per-device peak) ------------------
     flops_per_dev = _step_flops(step, state, batch_dict, root_rng) or (
-        _analytic_step_flops(batch, img) / n_dev
+        None if is_vit else _analytic_step_flops(batch, img) / n_dev
     )
     peak = _peak_tflops(jax.devices()[0])
-    mfu = (flops_per_dev * steps / dt) / (peak * 1e12) if peak else None
+    mfu = (
+        (flops_per_dev * steps / dt) / (peak * 1e12)
+        if peak and flops_per_dev
+        else None
+    )
 
     # ---- with-data rate (real pipeline in the loop) -------------------
     with_data = None
@@ -251,17 +304,27 @@ def main() -> None:
         f"mfu={mfu if mfu is None else round(mfu, 4)} with_data={with_data}",
         file=sys.stderr,
     )
+    if is_vit:
+        flash = "_flash" if config.moco.vit_flash_attention else ""
+        metric = (
+            f"moco_v3_{arch}{flash}_pretrain_imgs_per_sec_per_chip"
+            if on_tpu
+            else f"moco_v3_{arch}{flash}_cpu_smoke_imgs_per_sec"
+        )
+    elif on_tpu:
+        metric = "moco_v2_r50_pretrain_imgs_per_sec_per_chip"
+    else:
+        metric = "moco_v1_r18_cpu_smoke_imgs_per_sec"
     print(
         json.dumps(
             {
-                "metric": "moco_v2_r50_pretrain_imgs_per_sec_per_chip"
-                if on_tpu
-                else "moco_v1_r18_cpu_smoke_imgs_per_sec",
+                "metric": metric,
                 "value": round(per_chip, 2),
                 "unit": "imgs/sec/chip",
                 # apples-to-apples only on the real R50/224 TPU metric
+                # (the 168 imgs/s/GPU baseline is the reference's R50 run)
                 "vs_baseline": round(per_chip / REFERENCE_IMGS_PER_SEC_PER_GPU, 3)
-                if on_tpu
+                if on_tpu and not is_vit
                 else None,
                 "mfu": None if mfu is None else round(mfu, 4),
                 "with_data_imgs_per_sec_per_chip": None

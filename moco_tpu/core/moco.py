@@ -110,6 +110,15 @@ def create_backbone(cfg: MocoConfig, num_data: Optional[int] = None) -> nn.Modul
         groups = [list(range(i, i + g)) for i in range(0, num_data, g)]
     if cfg.bn_virtual_groups > 1 and cfg.shuffle == "syncbn":
         raise ValueError("bn_virtual_groups does not compose with syncbn")
+    if cfg.bn_virtual_groups > 1 and (cfg.shuffle == "none" or cfg.v3):
+        # must fail loudly: per-group BN with UNPERMUTED keys is the exact
+        # intra-batch statistics leak Shuffle-BN exists to prevent — worse
+        # than whole-batch BN, while the config would record virtual
+        # Shuffle-BN as active (the v3 step never shuffles at all)
+        raise ValueError(
+            "bn_virtual_groups needs a key permutation: use shuffle='gather_perm' "
+            "or 'a2a' (shuffle='none' and the v3 step would leak per-group stats)"
+        )
     return create_resnet(
         cfg.arch,
         cifar_stem=cfg.cifar_stem,

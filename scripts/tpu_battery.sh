@@ -1,0 +1,52 @@
+#!/bin/bash
+# Full TPU evidence battery (VERDICT r2 items 1, 3, 4) — run when the
+# axon tunnel is healthy. Sequential: the TPU admits ONE client; a
+# second process silently blocks. Each leg gets a generous timeout —
+# hitting it means the tunnel wedged (a 3-minute workload does not take
+# 30), at which point the SIGTERM is moot anyway. Pallas kernel tests
+# run LAST (a killed client mid-Mosaic-compile can wedge the lease).
+#
+# Output: artifacts/tpu_r3/*.json + logs; trace under /tmp/moco_trace_r3.
+set -u
+cd "$(dirname "$0")/.."
+L=artifacts/tpu_r3
+mkdir -p "$L"
+date > "$L/battery_started"
+
+run() { # name timeout_s env... -- cmd...
+  local name=$1 t=$2; shift 2
+  local envs=()
+  while [ "$1" != "--" ]; do envs+=("$1"); shift; done
+  shift
+  echo "=== $name ($(date +%H:%M:%S)) ===" | tee -a "$L/battery.log"
+  env "${envs[@]}" timeout "$t" "$@" > "$L/$name.out" 2> "$L/$name.log"
+  echo "rc=$? $name" | tee -a "$L/battery.log"
+}
+
+# 1. headline bench: device rate + MFU + with-data ladder + trace
+run bench_r50 2700 BENCH_TRACE_DIR=/tmp/moco_trace_r3 -- python bench.py
+
+# 2. fused-vs-dense InfoNCE A/B (device-only for clean numbers)
+run bench_r50_fused 900 BENCH_SKIP_DATA=1 BENCH_FUSED=1 -- python bench.py
+run bench_r50_dense 900 BENCH_SKIP_DATA=1 BENCH_FUSED=0 -- python bench.py
+
+# 3. BN-bytes lever A/B: subset-row statistics (PROFILE.md, 32 rows =
+#    the reference's per-GPU granularity) and virtual-group mode cost
+run bench_r50_bn32 900 BENCH_SKIP_DATA=1 BENCH_BN_STATS_ROWS=32 -- python bench.py
+run bench_r50_bn64 900 BENCH_SKIP_DATA=1 BENCH_BN_STATS_ROWS=64 -- python bench.py
+run bench_r50_vg8 900 BENCH_SKIP_DATA=1 BENCH_BN_VIRTUAL_GROUPS=8 -- python bench.py
+
+# 4. ViT v3 step bench, flash off/on
+run bench_vit 1200 BENCH_ARCH=vit_b16 BENCH_SKIP_DATA=1 -- python bench.py
+run bench_vit_flash 1200 BENCH_ARCH=vit_b16 BENCH_FLASH=1 BENCH_SKIP_DATA=1 -- python bench.py
+
+# 5. compiled (non-interpret) Pallas kernel tests — LAST (riskiest)
+run kernel_tests 1800 MOCO_TPU_TESTS=1 -- python -m pytest tests/test_tpu_kernels.py -q
+
+# 6. trace analysis (host-side, no TPU use)
+if [ -d /tmp/moco_trace_r3 ]; then
+  JAX_PLATFORMS=cpu timeout 600 python scripts/analyze_trace.py /tmp/moco_trace_r3 \
+    --flops 8.18e12 --bytes 100e9 > "$L/trace_analysis.txt" 2>&1
+fi
+date > "$L/battery_finished"
+echo "battery complete" | tee -a "$L/battery.log"
