@@ -48,16 +48,18 @@ ART_PATH = "artifacts/input_profile.json"
 
 def _sample_boxes(dims: np.ndarray, n_crops: int, seed: int, epoch: int, step: int,
                   idx: np.ndarray, scale=(0.2, 1.0)) -> np.ndarray:
-    """The pipeline's exact per-(row,crop) seeded box sampling
-    (moco_tpu/data/pipeline.py:_put_crop_batch)."""
-    from moco_tpu.data.datasets import sample_rrc_boxes
+    """The pipeline's exact box sampling (pipeline.py:_put_crop_batch):
+    one (seed, epoch, step)-keyed vectorized uniform draw for the whole
+    batch × crops, sliced by global position. (The prior per-(row, crop)
+    seeded-Generator scheme measured ~0.24 ms per crop of pure seeding
+    overhead here — the reason the pipeline was rewritten; 107x faster.)"""
+    from moco_tpu.data.datasets import draw_rrc_uniforms, rrc_boxes_from_uniforms
 
-    boxes = np.empty((len(idx), n_crops, 4), np.int32)
-    for row, ds_idx in enumerate(np.asarray(idx, np.int64)):
-        for c in range(n_crops):
-            rng = np.random.default_rng((seed, epoch, step, int(ds_idx), c))
-            boxes[row, c] = sample_rrc_boxes(rng, dims[row : row + 1], scale=scale)[0]
-    return boxes
+    rng = np.random.default_rng((seed, epoch, step))
+    u = draw_rrc_uniforms(rng, len(idx) * n_crops)
+    return rrc_boxes_from_uniforms(
+        u, np.repeat(dims, n_crops, axis=0), scale=scale
+    ).reshape(len(idx), n_crops, 4)
 
 
 def _time(fn, reps: int) -> float:
