@@ -205,15 +205,19 @@ def render_section(ablation_dir: str = ABLATION_DIR) -> str | None:
     return "\n".join(lines)
 
 
-def write_into_report(report_path: str = "REPORT.md", ablation_dir: str = ABLATION_DIR) -> None:
+def write_into_report(
+    report_path: str = "REPORT.md",
+    ablation_dir: str = ABLATION_DIR,
+    marker: str = "ablation",
+) -> None:
     """Insert/replace the marker-delimited ablation section in REPORT.md."""
     section = render_section(ablation_dir)
     if section is None:
         return
     from moco_tpu.utils.report import replace_marker_block
 
-    replace_marker_block(report_path, "ablation", section)
-    print(f"ablation section written into {report_path}")
+    replace_marker_block(report_path, marker, section)
+    print(f"ablation section ({marker}) written into {report_path}")
 
 
 def main() -> None:
@@ -232,6 +236,9 @@ def main() -> None:
     ap.add_argument("--momentum", type=float, default=0.99)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--report", default="REPORT.md")
+    ap.add_argument("--marker", default="ablation",
+                    help="report section marker (a second matrix, e.g. on "
+                    "synthetic_hard, uses its own marker so tables coexist)")
     args = ap.parse_args()
 
     os.makedirs(args.out, exist_ok=True)
@@ -246,7 +253,7 @@ def main() -> None:
             json.dump(result, f, indent=2)
         print(f"[{arm}] contrast tail {result['contrast_acc_tail_mean']:.2f}%  "
               f"kNN {result['final_knn_top1']}")
-    write_into_report(args.report, args.out)
+    write_into_report(args.report, args.out, marker=args.marker)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,202 @@
+"""BN-leak probe: does a trained MoCo model rely on batch-statistics
+cheating? (mechanism-level companion to scripts/ablate_shuffle.py)
+
+The Shuffle-BN design exists because, without it, per-device BatchNorm
+lets the key encoder leak the positive's identity through co-batch
+statistics (`moco/builder.py:~L79-126`). End-to-end metric gaps take
+long training to develop; this probe tests the MECHANISM directly on a
+finished checkpoint:
+
+  compute the (K+1)-way contrast accuracy twice, holding params, queue
+  and images fixed and changing ONLY the key batch's BN grouping:
+    aligned  — key row i normalized in the same group position as query
+               row i (the training-time co-batch composition of a
+               shuffle='none' run), and
+    shuffled — key rows permuted across groups before the forward and
+               inverse-permuted after (what Shuffle-BN enforces).
+
+A model that exploits the leak scores higher in `aligned` than in
+`shuffled` — its accuracy rides on batch composition, not content; an
+honest model scores the same in both. Per-device BN is emulated on one
+device with `BatchNorm(virtual_groups=G)` (oracle-tested equivalent of
+a G-device mesh), so the probe runs anywhere.
+
+Run after (or during) the ablation:
+    JAX_PLATFORMS=cpu python scripts/leak_probe.py --arms none gather_perm
+Writes artifacts/ablation/leak_probe.json and a marker section into
+REPORT.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from moco_tpu.utils.platform import pin_platform_from_env
+
+pin_platform_from_env()
+
+OUT_PATH = "artifacts/ablation/leak_probe.json"
+
+
+def probe_arm(arm: str, workdir: str, groups: int, batches: int, batch: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from moco_tpu.core import build_encoder, create_state
+    from moco_tpu.data.augment import get_recipe, two_crop_augment
+    from moco_tpu.data.datasets import build_dataset
+    from moco_tpu.utils.checkpoint import CheckpointManager
+    from moco_tpu.utils.config import config_from_dict
+    from moco_tpu.utils.schedules import build_optimizer
+
+    mgr = CheckpointManager(workdir)
+    if mgr.latest_step() is None:
+        raise FileNotFoundError(f"no checkpoint under {workdir}")
+    extra = mgr.read_extra()
+    config = config_from_dict(extra["config"])
+
+    # restore with the ORIGINAL config's template...
+    encoder = build_encoder(config.moco)
+    tx = build_optimizer(config.optim, steps_per_epoch=1)
+    sample = jnp.zeros((1, config.data.image_size, config.data.image_size, 3))
+    template = create_state(jax.random.PRNGKey(0), config, encoder, tx, sample)
+    state, _ = mgr.restore(template)
+    mgr.close()
+
+    # ...and forward with a virtual-groups backbone (identical tree
+    # paths, so the restored params drop straight in). syncbn-trained
+    # arms get plain per-group BN here too: the probe's question is
+    # only "does THIS parameter set read co-batch statistics".
+    probe_moco = dataclasses.replace(
+        config.moco, shuffle="gather_perm", bn_virtual_groups=groups
+    )
+    probe_encoder = build_encoder(probe_moco)
+
+    recipe = get_recipe(config.data.aug_plus, config.data.image_size)
+
+    @jax.jit
+    def embed(params, stats, images):
+        out = probe_encoder.apply(
+            {"params": params, "batch_stats": stats},
+            images,
+            train=True,  # batch (group) statistics — the training condition
+            mutable=["batch_stats"],
+        )[0]
+        return out / jnp.linalg.norm(out, axis=-1, keepdims=True)
+
+    dataset = build_dataset(config.data.dataset, config.data.data_dir,
+                            config.data.image_size, train=True)
+    rng = jax.random.PRNGKey(1234)
+    perm_rng = np.random.default_rng(99)
+    queue = jnp.asarray(state.queue)  # (K, dim) normalized keys
+
+    acc = {"aligned": [], "shuffled": []}
+    sim = {"aligned": [], "shuffled": []}
+    for b in range(batches):
+        idx = np.arange(b * batch, (b + 1) * batch) % len(dataset)
+        raw = np.stack([dataset.load(int(i))[0] for i in idx])
+        rng, key = jax.random.split(rng)
+        views = two_crop_augment(
+            recipe, key, jnp.asarray(raw, jnp.float32) / 255.0,
+            config.data.image_size,
+        )
+        im_q, im_k = views["im_q"], views["im_k"]
+        q = embed(state.params_q, state.batch_stats_q, im_q)
+
+        k_aligned = embed(state.params_k, state.batch_stats_k, im_k)
+        perm = perm_rng.permutation(batch)
+        inv = np.argsort(perm)
+        k_shuffled = embed(state.params_k, state.batch_stats_k, im_k[perm])[inv]
+
+        for name, k in (("aligned", k_aligned), ("shuffled", k_shuffled)):
+            l_pos = jnp.sum(q * k, axis=1, keepdims=True)
+            l_neg = q @ queue.T
+            logits = jnp.concatenate([l_pos, l_neg], axis=1)
+            acc[name].append(float((jnp.argmax(logits, axis=1) == 0).mean() * 100))
+            sim[name].append(float(l_pos.mean()))
+
+    import numpy as _np
+
+    return {
+        "arm": arm,
+        "groups": groups,
+        "batches": batches,
+        "batch": batch,
+        "contrast_acc_aligned": float(_np.mean(acc["aligned"])),
+        "contrast_acc_shuffled": float(_np.mean(acc["shuffled"])),
+        "acc_drop_when_decorrelated": float(
+            _np.mean(acc["aligned"]) - _np.mean(acc["shuffled"])
+        ),
+        "pos_sim_aligned": float(_np.mean(sim["aligned"])),
+        "pos_sim_shuffled": float(_np.mean(sim["shuffled"])),
+    }
+
+
+def render_section(results: list[dict]) -> str:
+    lines = [
+        "## BN-leak probe (mechanism test on trained checkpoints)",
+        "",
+        "`scripts/leak_probe.py`: same params, queue, and images; only the",
+        "key batch's BN grouping changes — `aligned` reproduces a",
+        "shuffle-free run's co-batch composition, `shuffled` decorrelates",
+        "it (per-device BN emulated via `BatchNorm(virtual_groups)`,",
+        "oracle-tested). Accuracy that evaporates under decorrelation was",
+        "never content — it was the BN statistics leak Shuffle-BN",
+        "prevents (`moco/builder.py:~L79-126`).",
+        "",
+        "| Arm | contrast acc, aligned | contrast acc, shuffled | drop |",
+        "|---|---|---|---|",
+    ]
+    for r in results:
+        lines.append(
+            f"| `{r['arm']}` | {r['contrast_acc_aligned']:.2f}% | "
+            f"{r['contrast_acc_shuffled']:.2f}% | "
+            f"{r['acc_drop_when_decorrelated']:+.2f}% |"
+        )
+    return "\n".join(lines)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--arms", nargs="*", default=["none", "gather_perm", "a2a", "syncbn", "m0"])
+    ap.add_argument("--workdir", default="/tmp/moco_ablate")
+    ap.add_argument("--groups", type=int, default=8)
+    ap.add_argument("--batches", type=int, default=4)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--out", default=OUT_PATH)
+    ap.add_argument("--report", default="REPORT.md")
+    ap.add_argument("--marker", default="leak-probe")
+    args = ap.parse_args()
+
+    results = []
+    for arm in args.arms:
+        workdir = os.path.join(args.workdir, arm)
+        try:
+            r = probe_arm(arm, workdir, args.groups, args.batches, args.batch)
+        except FileNotFoundError as e:
+            print(f"[{arm}] skipped: {e}")
+            continue
+        results.append(r)
+        print(f"[{arm}] aligned {r['contrast_acc_aligned']:.2f}%  "
+              f"shuffled {r['contrast_acc_shuffled']:.2f}%  "
+              f"drop {r['acc_drop_when_decorrelated']:+.2f}%")
+    if not results:
+        sys.exit("no arm checkpoints found")
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(results, f, indent=2)
+    from moco_tpu.utils.report import replace_marker_block
+
+    replace_marker_block(args.report, args.marker, render_section(results))
+    print(f"leak-probe section written into {args.report}")
+
+
+if __name__ == "__main__":
+    main()
