@@ -152,7 +152,9 @@ def render_section(ablation_dir: str = ABLATION_DIR) -> str | None:
     for name in sorted(os.listdir(ablation_dir)):
         if name.endswith(".json"):
             with open(os.path.join(ablation_dir, name)) as f:
-                results[name[:-5]] = json.load(f)
+                data = json.load(f)
+            if isinstance(data, dict) and "queue" in data:  # arm JSONs only
+                results[name[:-5]] = data
     if not results:
         return None
     any_r = next(iter(results.values()))
@@ -237,8 +239,9 @@ def main() -> None:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--report", default="REPORT.md")
     ap.add_argument("--marker", default="ablation",
-                    help="report section marker (a second matrix, e.g. on "
-                    "synthetic_hard, uses its own marker so tables coexist)")
+                    help="report section marker; a second matrix (e.g. on "
+                    "synthetic_hard) needs its own marker AND its own --out "
+                    "dir, else the arm JSONs overwrite each other")
     args = ap.parse_args()
 
     os.makedirs(args.out, exist_ok=True)

@@ -41,10 +41,10 @@ from moco_tpu.utils.platform import pin_platform_from_env
 
 pin_platform_from_env()
 
-OUT_PATH = "artifacts/ablation/leak_probe.json"
+OUT_PATH = "artifacts/leak_probe.json"  # NOT in the per-arm dir: render_section globs *.json there
 
 
-def probe_arm(arm: str, workdir: str, groups: int, batches: int, batch: int) -> dict:
+def probe_arm(arm: str, workdir: str, groups, batches: int, batch) -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -61,6 +61,18 @@ def probe_arm(arm: str, workdir: str, groups: int, batches: int, batch: int) -> 
         raise FileNotFoundError(f"no checkpoint under {workdir}")
     extra = mgr.read_extra()
     config = config_from_dict(extra["config"])
+    # default the grouping to the TRAINING topology recorded in the
+    # checkpoint: the 'aligned' condition must reproduce the run's
+    # per-device co-batch composition, not a guessed one
+    if batch is None:
+        batch = config.data.global_batch
+    if groups is None:
+        groups = int(extra.get("num_data", 1))
+    if groups < 2:
+        raise ValueError(
+            f"{arm}: trained on {groups} device(s) with no virtual groups - "
+            "per-device composition is the whole batch; pass --groups explicitly"
+        )
 
     # restore with the ORIGINAL config's template...
     encoder = build_encoder(config.moco)
@@ -122,20 +134,18 @@ def probe_arm(arm: str, workdir: str, groups: int, batches: int, batch: int) -> 
             acc[name].append(float((jnp.argmax(logits, axis=1) == 0).mean() * 100))
             sim[name].append(float(l_pos.mean()))
 
-    import numpy as _np
-
     return {
         "arm": arm,
         "groups": groups,
         "batches": batches,
         "batch": batch,
-        "contrast_acc_aligned": float(_np.mean(acc["aligned"])),
-        "contrast_acc_shuffled": float(_np.mean(acc["shuffled"])),
+        "contrast_acc_aligned": float(np.mean(acc["aligned"])),
+        "contrast_acc_shuffled": float(np.mean(acc["shuffled"])),
         "acc_drop_when_decorrelated": float(
-            _np.mean(acc["aligned"]) - _np.mean(acc["shuffled"])
+            np.mean(acc["aligned"]) - np.mean(acc["shuffled"])
         ),
-        "pos_sim_aligned": float(_np.mean(sim["aligned"])),
-        "pos_sim_shuffled": float(_np.mean(sim["shuffled"])),
+        "pos_sim_aligned": float(np.mean(sim["aligned"])),
+        "pos_sim_shuffled": float(np.mean(sim["shuffled"])),
     }
 
 
@@ -167,9 +177,11 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--arms", nargs="*", default=["none", "gather_perm", "a2a", "syncbn", "m0"])
     ap.add_argument("--workdir", default="/tmp/moco_ablate")
-    ap.add_argument("--groups", type=int, default=8)
+    ap.add_argument("--groups", type=int, default=None,
+                    help="BN groups (default: the checkpoint's num_data)")
     ap.add_argument("--batches", type=int, default=4)
-    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--batch", type=int, default=None,
+                    help="probe batch (default: the checkpoint's global batch)")
     ap.add_argument("--out", default=OUT_PATH)
     ap.add_argument("--report", default="REPORT.md")
     ap.add_argument("--marker", default="leak-probe")
@@ -189,7 +201,7 @@ def main() -> None:
               f"drop {r['acc_drop_when_decorrelated']:+.2f}%")
     if not results:
         sys.exit("no arm checkpoints found")
-    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    os.makedirs(os.path.dirname(args.out) or '.', exist_ok=True)
     with open(args.out, "w") as f:
         json.dump(results, f, indent=2)
     from moco_tpu.utils.report import replace_marker_block
