@@ -195,14 +195,17 @@ def render_section(ablation_dir: str = ABLATION_DIR) -> str | None:
         "",
         f"(contrast-acc chance {contrast_chance:.3f}%; kNN chance {chance:.1f}%.)",
         "",
-        "Reading: the `none` arm shows the BN-statistics cheat the",
-        "reference was built to prevent (`moco/builder.py:~L79-126`) —",
-        "contrast accuracy inflated above every honest arm while its",
-        "frozen-feature kNN falls below them; `a2a` tracking",
-        "`gather_perm` validates the cheaper balanced-permutation mode;",
-        "`syncbn` is the competitive no-shuffle alternative; `m0` shows",
-        "the EMA encoder's contribution (arXiv:1911.05722 §4.1).",
-        "Raw per-arm trajectories: `artifacts/ablation/*.json`.",
+        "What each arm answers: `none` is the cheat arm (the BN-statistics",
+        "leak the reference was built to prevent, `moco/builder.py:~L79-126`",
+        "— its signature, when it develops, is contrast accuracy above the",
+        "honest arms with degraded kNN); `a2a` vs `gather_perm` tests the",
+        "cheaper balanced-permutation mode's equivalence claim",
+        "(moco_tpu/parallel/shuffle.py); `syncbn` is the no-shuffle",
+        "alternative; `m0` isolates the EMA encoder (arXiv:1911.05722",
+        "§4.1). Arms within each other's noise band mean the phenomenon",
+        "has not developed at this budget — the mechanism-level",
+        "leak-probe section is the sharper instrument either way. Raw",
+        "per-arm trajectories: the arm JSONs next to this table's data.",
     ]
     return "\n".join(lines)
 
