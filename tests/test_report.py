@@ -1,0 +1,46 @@
+"""Marker-delimited report section helpers (moco_tpu/utils/report.py) —
+the evidence scripts all write through these; a splice bug would corrupt
+REPORT.md/PROFILE.md silently."""
+
+
+from moco_tpu.utils.report import extract_marker_blocks, replace_marker_block
+
+
+def test_insert_into_missing_file(tmp_path):
+    p = str(tmp_path / "r.md")
+    replace_marker_block(p, "abl", "## T\ndata")
+    text = open(p).read()
+    assert text == "<!-- abl:begin -->\n## T\ndata\n<!-- abl:end -->\n"
+
+
+def test_append_preserves_existing_body_and_replace_is_idempotent(tmp_path):
+    p = str(tmp_path / "r.md")
+    with open(p, "w") as f:
+        f.write("# Head\n\nbody\n")
+    replace_marker_block(p, "abl", "v1")
+    replace_marker_block(p, "abl", "v2")
+    text = open(p).read()
+    assert text.startswith("# Head\n\nbody\n")
+    assert text.count("<!-- abl:begin -->") == 1
+    assert "v2" in text and "v1" not in text
+
+
+def test_two_markers_coexist_and_extract_roundtrips(tmp_path):
+    p = str(tmp_path / "r.md")
+    with open(p, "w") as f:
+        f.write("intro\n")
+    replace_marker_block(p, "abl", "table-a")
+    replace_marker_block(p, "v3-signal", "table-b")
+    replace_marker_block(p, "abl", "table-a2")  # replace first, keep second
+    text = open(p).read()
+    blocks = extract_marker_blocks(text)
+    assert len(blocks) == 2
+    assert "table-a2" in blocks[0] and "table-b" in blocks[1]
+    # replacing a block never duplicates or reorders the others
+    assert text.index("abl:begin") < text.index("v3-signal:begin")
+
+
+def test_extract_ignores_mismatched_markers():
+    text = "<!-- a:begin -->x<!-- b:end -->\n<!-- c:begin -->y<!-- c:end -->"
+    blocks = extract_marker_blocks(text)
+    assert len(blocks) == 1 and "y" in blocks[0]
