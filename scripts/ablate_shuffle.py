@@ -162,7 +162,7 @@ def render_section(ablation_dir: str = ABLATION_DIR) -> str | None:
     contrast_chance = 100.0 / (1 + k)
     chance = 100.0 / 32 if any_r["dataset"] == "synthetic_hard" else 100.0 / 8
     lines = [
-        "## Shuffle-BN cheat + component ablation",
+        f"## Shuffle-BN cheat + component ablation (`{any_r['dataset']}`)",
         "",
         f"`scripts/ablate_shuffle.py` on `{any_r['dataset']}` ({any_r['backend']}, "
         f"{any_r['num_devices']} devices, global batch {any_r['global_batch']} = "

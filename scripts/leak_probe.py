@@ -136,6 +136,7 @@ def probe_arm(arm: str, workdir: str, groups, batches: int, batch) -> dict:
 
     return {
         "arm": arm,
+        "dataset": config.data.dataset,
         "groups": groups,
         "batches": batches,
         "batch": batch,
@@ -150,8 +151,11 @@ def probe_arm(arm: str, workdir: str, groups, batches: int, batch) -> dict:
 
 
 def render_section(results: list[dict]) -> str:
+    ds = results[0].get("dataset")
+    title = "## BN-leak probe (mechanism test on trained checkpoints"
+    title += f", `{ds}`)" if ds else ")"
     lines = [
-        "## BN-leak probe (mechanism test on trained checkpoints)",
+        title,
         "",
         "`scripts/leak_probe.py`: same params, queue, and images; only the",
         "key batch's BN grouping changes — `aligned` reproduces a",
