@@ -278,6 +278,112 @@ class HardSyntheticDataset:
         return (img * 255).astype(np.uint8), label
 
 
+class HardTemplateDataset:
+    """Second-generation hard learning-signal task (the redesign brief in
+    REPORT.md's hard-signal section): class identity is a FIXED texture
+    realization, instances are geometric transforms of it.
+
+    `HardSyntheticDataset` (class = power spectrum, instance = fresh
+    phases) measured unlearnable at CI budget: per-instance phases are
+    themselves a perfect crop-invariant instance signature, so instance
+    discrimination never needs class structure. Here the design inverts:
+    every instance of class c carries the SAME band-limited texture
+    realization T_c, seen under a random rotation + scale + toroidal
+    shift. Shared class structure (the template) is now the cheapest
+    crop-invariant signal — the regime where instance discrimination
+    provably transfers (the 8-class template task) — while pixel kNN
+    dies geometrically: the (rotation × scale × shift) transform space
+    is far too large for any bank to contain a near-aligned same-class
+    neighbor (`tests/test_data.py` pins pixel-kNN near chance).
+
+    STATUS (measured, REPORT.md hard-signal section): pixel-kNN at
+    chance as designed, but the 12-epoch CI-budget training gate FAILED
+    (kNN flat ~4%): a CNN solves instance discrimination with
+    rotation-SPECIFIC template features that do not cluster across a
+    class's rotations. Kept as the documented experiment; not
+    registered as a supported dataset. The lesson feeds the next
+    design: the class-shared signal must be invariant under transforms
+    conv features natively tolerate (translation/scale/appearance
+    noise), not rotation.
+    """
+
+    def __init__(
+        self,
+        num_examples: int = 16384,
+        image_size: int = 32,
+        num_classes: int = 32,
+        train: bool = True,
+        signal: float = 0.30,
+        nuisance: float = 0.25,
+        noise: float = 0.04,
+        scale_range: tuple[float, float] = (0.75, 1.35),
+    ):
+        self.num_examples = num_examples
+        self.image_size = image_size
+        self.num_classes = num_classes
+        self.signal = signal
+        self.nuisance = nuisance
+        self.noise = noise
+        self.scale_range = scale_range
+        self._seed_base = 0 if train else 9_000_017
+        # class templates: band-limited GRF realizations on a 2x-size
+        # torus (band chosen so a 1x window sees ~2-8 cycles; the torus
+        # wraps, so any rotated/scaled window samples valid texture)
+        t = 2 * image_size
+        fy = np.fft.fftfreq(t)[:, None] * t
+        fx = np.fft.fftfreq(t)[None, :] * t
+        r = np.hypot(fy, fx)
+        # 4-16 cycles per 2x torus = 2-8 per 1x window
+        band = ((r >= 4.0) & (r <= 16.0)).astype(np.float64)
+        self._templates = np.empty((num_classes, t, t, 3))
+        for c in range(num_classes):
+            rng = np.random.default_rng(77_700 + c)
+            white = rng.normal(size=(3, t, t))
+            tex = np.fft.ifft2(np.fft.fft2(white, axes=(1, 2)) * band, axes=(1, 2)).real
+            tex /= tex.std(axis=(1, 2), keepdims=True) + 1e-8
+            self._templates[c] = tex.transpose(1, 2, 0)
+
+    def __len__(self) -> int:
+        return self.num_examples
+
+    def load(self, index: int, decode_size: Optional[int] = None) -> tuple[np.ndarray, int]:
+        size = decode_size or self.image_size
+        label = int(index % self.num_classes)
+        rng = np.random.default_rng(self._seed_base + index)
+        t = self._templates[label]
+        ts = t.shape[0]
+        s = self.image_size
+        theta = rng.uniform(0.0, 2 * np.pi)
+        zoom = rng.uniform(*self.scale_range)
+        dy, dx = rng.uniform(0.0, ts, 2)
+        # inverse-map the s x s window through rotate/scale/shift on the torus
+        yy, xx = np.mgrid[0:s, 0:s].astype(np.float64)
+        ct, st = np.cos(theta), np.sin(theta)
+        sy = (ct * yy - st * xx) / zoom + dy
+        sx = (st * yy + ct * xx) / zoom + dx
+        y0 = np.floor(sy).astype(int)
+        x0 = np.floor(sx).astype(int)
+        wy = (sy - y0)[..., None]
+        wx = (sx - x0)[..., None]
+        y0 %= ts; x0 %= ts
+        y1 = (y0 + 1) % ts
+        x1 = (x0 + 1) % ts
+        tex = (
+            t[y0, x0] * (1 - wy) * (1 - wx)
+            + t[y0, x1] * (1 - wy) * wx
+            + t[y1, x0] * wy * (1 - wx)
+            + t[y1, x1] * wy * wx
+        )
+        img = 0.5 + self.signal * tex
+        coarse = rng.uniform(-1.0, 1.0, (4, 4, 3))
+        img = img + self.nuisance * _bilinear_upsample(coarse, s)
+        img = img + rng.normal(0.0, self.noise, img.shape)
+        img = np.clip(img, 0.0, 1.0)
+        if size != s:
+            img = _bilinear_upsample(img, size)
+        return (img * 255).astype(np.uint8), label
+
+
 def _bilinear_upsample(field: np.ndarray, size: int) -> np.ndarray:
     """(h, w, c) float -> (size, size, c) bilinear (numpy, no deps)."""
     h, w, _ = field.shape
