@@ -110,6 +110,25 @@ def create_backbone(cfg: MocoConfig, num_data: Optional[int] = None) -> nn.Modul
         groups = [list(range(i, i + g)) for i in range(0, num_data, g)]
     if cfg.bn_virtual_groups > 1 and cfg.shuffle == "syncbn":
         raise ValueError("bn_virtual_groups does not compose with syncbn")
+    if (
+        cfg.bn_stats_rows
+        and (cfg.shuffle == "none" or cfg.v3)
+        and (num_data or 1) > 1
+    ):
+        # same leak logic as the virtual-groups gate below, sharpened:
+        # statistics over a FIXED first-r-rows subset leak more than
+        # whole-batch per-device BN (fewer rows correlate query/key
+        # composition more tightly), so the perf lever must not be
+        # combinable with unpermuted multi-device keys — and the v3
+        # step never shuffles at all, so it is equally exposed.
+        # Single-device training keeps it available (no cross-device
+        # composition to leak beyond the known single-GPU MoCo caveat).
+        raise ValueError(
+            "bn_stats_rows needs a key permutation on a multi-device data "
+            "axis (fixed first-N-rows statistics concentrate the BN leak "
+            "Shuffle-BN prevents): use shuffle='gather_perm' or 'a2a', and "
+            "leave it unset for the v3 step, which never shuffles"
+        )
     if cfg.bn_virtual_groups > 1 and (cfg.shuffle == "none" or cfg.v3):
         # must fail loudly: per-group BN with UNPERMUTED keys is the exact
         # intra-batch statistics leak Shuffle-BN exists to prevent — worse

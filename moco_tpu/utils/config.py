@@ -35,6 +35,10 @@ class MocoConfig:
     # (PROFILE.md: stats reductions are 55% of step time) that matches
     # the reference's statistics granularity — upstream's per-GPU BN
     # estimates from 32 rows (batch 256 / 8 GPUs, main_moco.py:~L172).
+    # Interacts with the shuffle gate: a fixed first-N-rows sample makes
+    # the BN-statistics leak STRONGER than whole-batch per-device BN, so
+    # build_encoder rejects it with shuffle='none' on a multi-device
+    # data axis (fine single-device, where it is a pure perf lever).
     bn_stats_rows: int = 0
     # Virtual Shuffle-BN on few devices: per-group BN statistics over G
     # contiguous row-groups of each device's batch (the reference's

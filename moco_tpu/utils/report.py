@@ -21,9 +21,18 @@ def replace_marker_block(path: str, name: str, section: str) -> None:
     if os.path.exists(path):
         with open(path) as f:
             text = f.read()
-    if begin in text and end in text:
-        pre = text[: text.index(begin)]
-        post = text[text.index(end) + len(end) :].lstrip("\n")
+    begin_idx = text.find(begin)
+    # search for end only AFTER begin: an orphan end marker before begin
+    # (truncated write, hand edit) must not drive the splice backwards
+    end_idx = text.find(end, begin_idx) if begin_idx != -1 else -1
+    if begin_idx != -1 and end_idx == -1:
+        raise ValueError(
+            f"{path}: unbalanced marker block {name!r} (begin without a "
+            f"following end) — fix the file before regenerating the section"
+        )
+    if begin_idx != -1:
+        pre = text[:begin_idx]
+        post = text[end_idx + len(end) :].lstrip("\n")
         text = pre + block + post
     else:
         text = text.rstrip("\n") + "\n\n" + block if text else block

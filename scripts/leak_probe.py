@@ -23,8 +23,10 @@ a G-device mesh), so the probe runs anywhere.
 
 Run after (or during) the ablation:
     JAX_PLATFORMS=cpu python scripts/leak_probe.py --arms none gather_perm
-Writes artifacts/ablation/leak_probe.json and a marker section into
-REPORT.md.
+Writes artifacts/leak_probe.json — deliberately OUTSIDE the per-arm
+artifacts/ablation/ directory, whose `*.json` glob render_section in
+scripts/ablate_shuffle.py treats as arm results — and a marker section
+into REPORT.md.
 """
 
 from __future__ import annotations

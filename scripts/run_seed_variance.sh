@@ -1,0 +1,21 @@
+#!/bin/bash
+# VERDICT r3 #4: seed-variance for the shuffle-mode ablation. Seeds 1,2
+# for gather_perm/a2a/syncbn at the EXACT r3 seed-0 budget (epochs 10,
+# examples 1024, batch 64, K=2048) so the three seeds pool into one
+# mean±range table. Sequential: host has one core. Report write goes to
+# a throwaway file; the aggregate section is rendered by
+# scripts/seed_variance_report.py afterwards.
+set -u
+cd "$(dirname "$0")/.."
+for seed in 1 2; do
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python scripts/ablate_shuffle.py \
+    --arms gather_perm a2a syncbn \
+    --epochs 10 --examples 1024 --batch 64 --queue 2048 \
+    --seed "$seed" \
+    --workdir "/tmp/moco_ablate_seed$seed" \
+    --out "artifacts/ablation_seeds/seed$seed" \
+    --report "/tmp/seed_report_scratch.md" --marker "ablation-seeds-scratch" \
+    >> artifacts/ablation_seeds/run.log 2>&1
+done
+echo done > artifacts/ablation_seeds/finished

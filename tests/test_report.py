@@ -44,3 +44,27 @@ def test_extract_ignores_mismatched_markers():
     text = "<!-- a:begin -->x<!-- b:end -->\n<!-- c:begin -->y<!-- c:end -->"
     blocks = extract_marker_blocks(text)
     assert len(blocks) == 1 and "y" in blocks[0]
+
+
+def test_orphan_end_before_begin_does_not_corrupt(tmp_path):
+    # an end marker BEFORE the begin marker (hand edit / truncated write)
+    # must not drive the splice backwards through surrounding text
+    p = str(tmp_path / "r.md")
+    with open(p, "w") as f:
+        f.write("intro\n<!-- abl:end -->\nmiddle\n<!-- abl:begin -->\nold\n"
+                "<!-- abl:end -->\ntail\n")
+    replace_marker_block(p, "abl", "new")
+    text = open(p).read()
+    assert "intro" in text and "middle" in text and "tail" in text
+    assert "new" in text and "old" not in text
+
+
+def test_orphan_begin_without_end_raises(tmp_path):
+    import pytest
+
+    p = str(tmp_path / "r.md")
+    with open(p, "w") as f:
+        f.write("head\n<!-- abl:begin -->\ntruncated")
+    with pytest.raises(ValueError, match="unbalanced"):
+        replace_marker_block(p, "abl", "new")
+    assert "truncated" in open(p).read()  # file untouched on error

@@ -166,6 +166,30 @@ class TestSubsetStatsBatchNorm:
             lambda a, b: np.testing.assert_allclose(a, b, atol=0), vf, vs
         )
 
+    def test_subset_bn_rejected_with_unpermuted_multi_device_keys(self):
+        # fixed first-r-rows statistics + shuffle='none' on a data axis
+        # concentrates the BN leak Shuffle-BN prevents — must fail loudly
+        import pytest
+
+        from moco_tpu.core import build_encoder
+        from moco_tpu.utils.config import MocoConfig
+
+        cfg = MocoConfig(
+            arch="resnet18", shuffle="none", cifar_stem=True, bn_stats_rows=2
+        )
+        with pytest.raises(ValueError, match="bn_stats_rows"):
+            build_encoder(cfg, num_data=8)
+        # the v3 step never shuffles — equally exposed, equally rejected
+        cfg_v3 = MocoConfig(
+            arch="resnet18", v3=True, num_negatives=0, shuffle="gather_perm",
+            cifar_stem=True, bn_stats_rows=2,
+        )
+        with pytest.raises(ValueError, match="bn_stats_rows"):
+            build_encoder(cfg_v3, num_data=8)
+        # single-device stays available: pure perf lever, no cross-device
+        # composition to leak
+        build_encoder(cfg, num_data=1)
+
     def test_train_step_runs_with_subset_bn(self):
         from moco_tpu.core import build_encoder, create_state, make_train_step, place_state
         from moco_tpu.parallel import create_mesh
