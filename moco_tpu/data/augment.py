@@ -341,6 +341,11 @@ V2_RECIPE = AugRecipe("v2", True, (0.4, 0.4, 0.4, 0.1), 0.8, 0.2, 0.5)
 # Linear-probe training transform (`main_lincls.py` train pipeline):
 # RandomResizedCrop (default scale 0.08-1.0) + flip + normalize only.
 PROBE_RECIPE = AugRecipe("probe", True, (0.0, 0.0, 0.0, 0.0), 0.0, 0.0, 0.0, (0.08, 1.0))
+# Geometric-only two-crop recipe (RRC + flip + normalize, pretrain crop
+# scale): the BN-leak positive control's setting, where photometric
+# jitter would swamp the weak global tint that carries BOTH the honest
+# and the cheat channel (LeakControlSyntheticDataset).
+CROPS_ONLY_RECIPE = AugRecipe("probe", True, (0.0, 0.0, 0.0, 0.0), 0.0, 0.0, 0.0, (0.2, 1.0))
 
 
 def apply_recipe(
@@ -379,10 +384,10 @@ def two_crop_augment(
     }
 
 
-def get_recipe(aug_plus: bool, image_size: int) -> AugRecipe:
+def get_recipe(aug_plus: bool, image_size: int, crops_only: bool = False) -> AugRecipe:
     """Recipe lookup; CIFAR-sized inputs skip blur (23-tap blur on 32px is
     degenerate) and use CIFAR normalization stats."""
-    base = V2_RECIPE if aug_plus else V1_RECIPE
+    base = CROPS_ONLY_RECIPE if crops_only else (V2_RECIPE if aug_plus else V1_RECIPE)
     if image_size <= 64:
         return base._replace(
             blur_prob=0.0,

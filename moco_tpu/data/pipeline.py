@@ -189,7 +189,9 @@ class TwoCropPipeline(_HostPipeline):
 
     def __init__(self, config: DataConfig, mesh: Mesh, seed: int = 0, dataset=None, train: bool = True):
         super().__init__(config, mesh, seed=seed, dataset=dataset, train=train, drop_last=True)
-        self.recipe: AugRecipe = get_recipe(config.aug_plus, config.image_size)
+        self.recipe: AugRecipe = get_recipe(
+            config.aug_plus, config.image_size, crops_only=config.crops_only
+        )
         recipe, out_size = self.recipe, config.image_size
 
         @jax.jit

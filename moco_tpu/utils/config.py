@@ -103,6 +103,9 @@ class DataConfig:
     image_size: int = 224
     global_batch: int = 256
     aug_plus: bool = False  # v2 aug recipe (jitter+blur), main_moco.py:~L225-255
+    # Geometric-only two-crop recipe (RRC + flip + normalize): the
+    # BN-leak positive control's setting — overrides aug_plus.
+    crops_only: bool = False
     num_workers: int = 4
     on_device_augment: bool = True
     # Sample RandomResizedCrop boxes on the HOST against the ORIGINAL

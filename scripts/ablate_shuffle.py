@@ -91,6 +91,7 @@ def run_arm(arm: str, args) -> dict:
             image_size=32,
             global_batch=args.batch,
             aug_plus=True,
+            crops_only=args.crops_only,
         ),
         parallel=ParallelConfig(num_data=n_dev),
         workdir=workdir,
@@ -229,7 +230,12 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--arms", nargs="*", default=list(ARMS), choices=ARMS)
     ap.add_argument("--dataset", default="synthetic_learnable",
-                    choices=("synthetic_learnable", "synthetic_hard"))
+                    choices=("synthetic_learnable", "synthetic_hard",
+                             "synthetic_leak_control"))
+    ap.add_argument("--crops-only", action="store_true",
+                    help="geometric-only augmentation (RRC+flip+normalize) — "
+                    "required for the leak-control task, whose weak global "
+                    "tint photometric jitter would swamp")
     ap.add_argument("--workdir", default="/tmp/moco_ablate")
     ap.add_argument("--out", default=ABLATION_DIR)
     ap.add_argument("--examples", type=int, default=2048)

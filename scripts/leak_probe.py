@@ -93,7 +93,11 @@ def probe_arm(arm: str, workdir: str, groups, batches: int, batch) -> dict:
     )
     probe_encoder = build_encoder(probe_moco)
 
-    recipe = get_recipe(config.data.aug_plus, config.data.image_size)
+    recipe = get_recipe(
+        config.data.aug_plus,
+        config.data.image_size,
+        crops_only=getattr(config.data, "crops_only", False),
+    )
 
     @jax.jit
     def embed(params, stats, images):

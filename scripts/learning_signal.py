@@ -53,7 +53,8 @@ def main() -> None:
     ap.add_argument("--arch", default=None, help="v3 backbone (default vit_tiny)")
     ap.add_argument("--lr", type=float, default=None)
     ap.add_argument("--dataset", default="synthetic_learnable",
-                    choices=("synthetic_learnable", "synthetic_hard"))
+                    choices=("synthetic_learnable", "synthetic_hard",
+                             "synthetic_learnable32"))
     args = ap.parse_args()
     if args.v3 and args.workdir == DEFAULT_WORKDIR:
         # never share the baseline run's workdir: train() would auto-resume
@@ -138,6 +139,16 @@ def main() -> None:
         num_classes = 32
         bank = HardSyntheticDataset(args.examples, 32, num_classes, train=True)
         test = HardSyntheticDataset(max(args.examples // 8, 512), 32, num_classes, train=False)
+    elif args.dataset == "synthetic_learnable32":
+        # round-3 redesign survivor: proven template structure, 32
+        # classes, heavy per-instance noise (REPORT.md hard-signal
+        # lesson v2) — the budget-binding claim's test article
+        num_classes = 32
+        mk = lambda n, train: LearnableSyntheticDataset(  # noqa: E731
+            n, 32, num_classes, train=train, noise=0.5
+        )
+        bank = mk(args.examples, True)
+        test = mk(max(args.examples // 8, 512), False)
     else:
         num_classes = 8
         bank = LearnableSyntheticDataset(args.examples, 32, num_classes, train=True)
@@ -158,7 +169,10 @@ def main() -> None:
     print(f"raw-pixel kNN top-1: {pixel_top1:.2f}%")
 
     # ---- pretrain (with the periodic kNN monitor) ---------------------
-    dataset = type(bank)(args.examples, 32, num_classes, train=True)
+    if args.dataset == "synthetic_learnable32":
+        dataset = mk(args.examples, True)  # keep the noise=0.5 variant
+    else:
+        dataset = type(bank)(args.examples, 32, num_classes, train=True)
     final = train(config, dataset=dataset, knn_datasets=(bank, test))
     print("pretrain final:", final)
 

@@ -1,0 +1,140 @@
+"""Aggregate the shuffle-mode ablation across seeds (VERDICT r3 #4).
+
+Pools the seed-0 arms under `artifacts/ablation/` with the seed-N arms
+under `artifacts/ablation_seeds/seed<N>/` (all run at the identical
+budget: epochs 10, 1024 examples, batch 64, K=2048) into one
+mean ± range table per arm, and rewrites the `ablation-seeds` marker
+section of REPORT.md. The question it answers is weak #3: does the
+a2a-vs-gather_perm gap (2.7 pts on one seed) survive a noise band, or
+does it close — i.e. is `parallel/shuffle.py`'s "statistically
+equivalent decorrelation" claim empirically backed?
+
+Run (host-side only, no training):
+    python scripts/seed_variance_report.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ARMS = ("gather_perm", "a2a", "syncbn")
+LABELS = {
+    "gather_perm": "Shuffle-BN (reference-exact)",
+    "a2a": "balanced all_to_all",
+    "syncbn": "cross-replica BN",
+}
+
+
+def collect(base_dir: str = "artifacts") -> dict[str, list[dict]]:
+    """arm -> list of per-seed result dicts, seed-sorted."""
+    dirs = [os.path.join(base_dir, "ablation")]
+    seeds_root = os.path.join(base_dir, "ablation_seeds")
+    if os.path.isdir(seeds_root):
+        dirs += sorted(
+            os.path.join(seeds_root, d)
+            for d in os.listdir(seeds_root)
+            if d.startswith("seed")
+        )
+    out: dict[str, list[dict]] = {a: [] for a in ARMS}
+    for d in dirs:
+        for arm in ARMS:
+            p = os.path.join(d, f"{arm}.json")
+            if os.path.exists(p):
+                with open(p) as f:
+                    out[arm].append(json.load(f))
+    for arm in out:
+        out[arm].sort(key=lambda r: r["seed"])
+    return out
+
+
+def render_section(results: dict[str, list[dict]]) -> str | None:
+    import numpy as np
+
+    present = {a: rs for a, rs in results.items() if rs}
+    if not present:
+        return None
+    any_rs = next(iter(present.values()))
+    budgets = {
+        (r["epochs"], r["examples"], r["global_batch"], r["queue"])
+        for rs in present.values()
+        for r in rs
+    }
+    if len(budgets) != 1:
+        raise ValueError(f"mixed budgets across seed runs: {budgets}")
+    e, n, b, k = budgets.pop()
+    lines = [
+        "## Shuffle-mode ablation: seed variance",
+        "",
+        f"`scripts/seed_variance_report.py`: pooled over seeds "
+        f"{[r['seed'] for r in any_rs]} at the identical budget "
+        f"({e} epochs, {n} examples, batch {b}, K={k}, "
+        f"`{any_rs[0]['dataset']}`, {any_rs[0]['num_devices']}-device CPU "
+        "mesh). mean ± half-range (min–max shown); the question is "
+        "whether the single-seed a2a-vs-gather_perm gap survives the "
+        "noise band (`parallel/shuffle.py`'s equivalence claim).",
+        "",
+        "| Arm | kNN top-1 mean ± ½range | per-seed | contrast-acc tail mean |",
+        "|---|---|---|---|",
+    ]
+    stats = {}
+    for arm in ARMS:
+        rs = present.get(arm)
+        if not rs:
+            continue
+        knn = np.array([r["final_knn_top1"] for r in rs], float)
+        tail = np.array([r["contrast_acc_tail_mean"] for r in rs], float)
+        stats[arm] = knn
+        per_seed = ", ".join(
+            f"s{r['seed']}: {v:.1f}" for r, v in zip(rs, knn)
+        )
+        lines.append(
+            f"| `{arm}` | {knn.mean():.2f} ± {(knn.max() - knn.min()) / 2:.2f} | "
+            f"{per_seed} | {tail.mean():.2f}% |"
+        )
+    verdict_line = None
+    if "gather_perm" in stats and "a2a" in stats and len(stats["a2a"]) >= 3:
+        g, a = stats["gather_perm"], stats["a2a"]
+        gap = g.mean() - a.mean()
+        band = max(g.max() - g.min(), a.max() - a.min())
+        if abs(gap) <= band:
+            verdict_line = (
+                f"The mean gap ({gap:+.2f} pts) sits inside the larger "
+                f"per-arm seed range ({band:.2f} pts): the a2a mode's "
+                "decorrelation is statistically indistinguishable from "
+                "reference-exact gather_perm at this budget — the "
+                "equivalence claim stands."
+            )
+        else:
+            verdict_line = (
+                f"The mean gap ({gap:+.2f} pts) EXCEEDS the per-arm seed "
+                f"range ({band:.2f} pts): a2a is demoted from "
+                "default-candidate to experimental until the gap is "
+                "understood (parallel/shuffle.py's claim overstated)."
+            )
+    if verdict_line:
+        lines += ["", verdict_line]
+    return "\n".join(lines)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--base-dir", default="artifacts")
+    ap.add_argument("--report", default="REPORT.md")
+    args = ap.parse_args()
+    section = render_section(collect(args.base_dir))
+    if section is None:
+        print("no arm results found")
+        return
+    from moco_tpu.utils.report import replace_marker_block
+
+    replace_marker_block(args.report, "ablation-seeds", section)
+    print(f"ablation-seeds section written into {args.report}")
+
+
+if __name__ == "__main__":
+    main()

@@ -360,3 +360,68 @@ class TestHardTemplateDataset:
         preds = [np.bincount(by[idx[r]], minlength=32).argmax() for r in range(128)]
         acc = 100 * np.mean(np.array(preds) == ty)
         assert acc < 4 * (100.0 / 32), f"pixel kNN {acc:.1f}% leaks class signal"
+
+
+class TestLeakControlDataset:
+    """BN-cheat positive control (VERDICT r3 missing #3): the statics the
+    adversarial design depends on — a weak crop-estimable tint as the
+    ONLY content signal, and strong query/key co-batch fingerprint
+    correlation at 2-row groups."""
+
+    def test_deterministic_and_registered(self):
+        from moco_tpu.data.datasets import (
+            LeakControlSyntheticDataset,
+            build_dataset,
+        )
+
+        a = LeakControlSyntheticDataset(64)
+        b = LeakControlSyntheticDataset(64)
+        img, label = a.load(11)
+        np.testing.assert_array_equal(img, b.load(11)[0])
+        assert img.shape == (32, 32, 3) and img.dtype == np.uint8
+        assert label == 11 % 8
+        ds = build_dataset("synthetic_leak_control", None, 32, train=True)
+        assert isinstance(ds, LeakControlSyntheticDataset)
+        # train/test draw disjoint instances
+        t = build_dataset("synthetic_leak_control", None, 32, train=False)
+        assert not np.array_equal(ds.load(0)[0], t.load(0)[0])
+
+    def test_group_fingerprint_dominates_per_crop_signal(self):
+        from moco_tpu.data.datasets import LeakControlSyntheticDataset
+
+        ds = LeakControlSyntheticDataset(256)
+        imgs = np.stack(
+            [ds.load(i)[0].astype(np.float32) / 255.0 for i in range(256)]
+        )
+        # two disjoint 16x16 crops stand in for the two views
+        q = imgs[:, :16, :16].mean(axis=(1, 2))
+        k = imgs[:, 16:, 16:].mean(axis=(1, 2))
+        # 2-row group means (the per-device BN stats at batch 16 over 8
+        # devices): query-group vs key-group correlation must be strong —
+        # this is the channel BN injects and Shuffle-BN severs
+        gq = (q[0::2] + q[1::2]) / 2
+        gk = (k[0::2] + k[1::2]) / 2
+        corr = np.corrcoef(gq.ravel(), gk.ravel())[0, 1]
+        assert corr > 0.5, f"co-batch fingerprint too weak: corr {corr:.2f}"
+
+    def test_learnable32_registered_with_heavy_noise(self):
+        from moco_tpu.data.datasets import (
+            LearnableSyntheticDataset,
+            build_dataset,
+        )
+
+        ds = build_dataset("synthetic_learnable32", None, 32, train=True)
+        assert isinstance(ds, LearnableSyntheticDataset)
+        assert ds.num_classes == 32 and ds.noise == 0.5
+
+
+def test_crops_only_recipe_selection():
+    from moco_tpu.data.augment import get_recipe
+
+    r = get_recipe(True, 32, crops_only=True)
+    assert r.jitter == (0.0, 0.0, 0.0, 0.0)
+    assert r.grayscale_prob == 0.0 and r.blur_prob == 0.0
+    assert r.crop and r.crop_scale == (0.2, 1.0)  # pretrain crop scale
+    assert r.mean == (0.4914, 0.4822, 0.4465)  # cifar stats at 32px
+    # default path unchanged
+    assert get_recipe(True, 32).jitter[0] == 0.4
