@@ -6,10 +6,10 @@
 # 30), at which point the SIGTERM is moot anyway. Pallas kernel tests
 # run LAST (a killed client mid-Mosaic-compile can wedge the lease).
 #
-# Output: artifacts/tpu_r3/*.json + logs; trace under /tmp/moco_trace_r3.
+# Output: artifacts/tpu_r4/*.json + logs; trace under /tmp/moco_trace_r4.
 set -u
 cd "$(dirname "$0")/.."
-L=artifacts/tpu_r3
+L=artifacts/tpu_r4
 mkdir -p "$L"
 date > "$L/battery_started"
 
@@ -24,7 +24,7 @@ run() { # name timeout_s env... -- cmd...
 }
 
 # 1. headline bench: device rate + MFU + with-data ladder + trace
-run bench_r50 2700 BENCH_TRACE_DIR=/tmp/moco_trace_r3 -- python bench.py
+run bench_r50 2700 BENCH_TRACE_DIR=/tmp/moco_trace_r4 -- python bench.py
 
 # 2. fused-vs-dense InfoNCE A/B (device-only for clean numbers)
 run bench_r50_fused 900 BENCH_SKIP_DATA=1 BENCH_FUSED=1 -- python bench.py
@@ -43,9 +43,17 @@ run bench_vit_flash 1200 BENCH_ARCH=vit_b16 BENCH_FLASH=1 BENCH_SKIP_DATA=1 -- p
 # 5. compiled (non-interpret) Pallas kernel tests — LAST (riskiest)
 run kernel_tests 1800 MOCO_TPU_TESTS=1 -- python -m pytest tests/test_tpu_kernels.py -q
 
+# 5b. TPU-tunnel host->device transfer anchor (PROFILE.md input section:
+#    the 765 MB/s loopback number needs its real-tunnel counterpart;
+#    small geometry keeps host-side stages quick on the 1-core box)
+rm -rf /tmp/moco_input_profile_cache   # cache stamps are listing-exact
+run input_transfer 1200 -- python scripts/profile_input.py --batch 64 --n-images 1024 \
+  --reps 2 --threads 1 --out-size 224 --src-size 256 \
+  --profile-md artifacts/tpu_r4/input_profile_tpu.md --artifact artifacts/tpu_r4/input_profile_tpu.json
+
 # 6. trace analysis (host-side, no TPU use)
-if [ -d /tmp/moco_trace_r3 ]; then
-  JAX_PLATFORMS=cpu timeout 600 python scripts/analyze_trace.py /tmp/moco_trace_r3 \
+if [ -d /tmp/moco_trace_r4 ]; then
+  JAX_PLATFORMS=cpu timeout 600 python scripts/analyze_trace.py /tmp/moco_trace_r4 \
     --flops 8.18e12 --bytes 100e9 > "$L/trace_analysis.txt" 2>&1
 fi
 date > "$L/battery_finished"
