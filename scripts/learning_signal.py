@@ -55,6 +55,9 @@ def main() -> None:
     ap.add_argument("--dataset", default="synthetic_learnable",
                     choices=("synthetic_learnable", "synthetic_hard",
                              "synthetic_learnable32"))
+    ap.add_argument("--bn-stats-rows", type=int, default=0,
+                    help="subset-row BN statistics (accuracy arm of the "
+                    "BN-bytes lever; 0 = full-batch stats)")
     args = ap.parse_args()
     if args.v3 and args.workdir == DEFAULT_WORKDIR:
         # never share the baseline run's workdir: train() would auto-resume
@@ -112,6 +115,11 @@ def main() -> None:
             shuffle="gather_perm" if n_dev > 1 else "none",
             cifar_stem=True,
             compute_dtype=dtype,
+            # VERDICT r3 #2's accuracy arm: the BN-bytes perf lever
+            # changes training semantics (stats + their gradients from
+            # the first N rows only, models/resnet.py) — a win on step
+            # time must show the RECIPE survives subset statistics
+            bn_stats_rows=args.bn_stats_rows,
         )
         optim = OptimConfig(
             lr=args.lr if args.lr is not None else 0.06,
@@ -211,6 +219,7 @@ def main() -> None:
         "dataset": args.dataset,
         "arch": config.moco.arch,
         "v3": args.v3,
+        "bn_stats_rows": args.bn_stats_rows,
         "pixel_top1": pixel_top1,
         "probe_metrics": probe_metrics,
         "final_knn": final.get("knn_top1"),
