@@ -114,6 +114,7 @@ def create_backbone(cfg: MocoConfig, num_data: Optional[int] = None) -> nn.Modul
         cfg.bn_stats_rows
         and (cfg.shuffle == "none" or cfg.v3)
         and (num_data or 1) > 1
+        and not cfg.allow_leaky_bn
     ):
         # same leak logic as the virtual-groups gate below, sharpened:
         # statistics over a FIXED first-r-rows subset leak more than
@@ -129,7 +130,11 @@ def create_backbone(cfg: MocoConfig, num_data: Optional[int] = None) -> nn.Modul
             "Shuffle-BN prevents): use shuffle='gather_perm' or 'a2a', and "
             "leave it unset for the v3 step, which never shuffles"
         )
-    if cfg.bn_virtual_groups > 1 and (cfg.shuffle == "none" or cfg.v3):
+    if (
+        cfg.bn_virtual_groups > 1
+        and (cfg.shuffle == "none" or cfg.v3)
+        and not cfg.allow_leaky_bn
+    ):
         # must fail loudly: per-group BN with UNPERMUTED keys is the exact
         # intra-batch statistics leak Shuffle-BN exists to prevent — worse
         # than whole-batch BN, while the config would record virtual

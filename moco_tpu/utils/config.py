@@ -46,6 +46,15 @@ class MocoConfig:
     # permuted in-batch even on a single device so group composition
     # decorrelates — a G-GPU recipe on one TPU. 0 = off.
     bn_virtual_groups: int = 0
+    # EXPLICIT opt-in for leak-demonstration configs: lets shuffle='none'
+    # compose with bn_virtual_groups / bn_stats_rows, which build_encoder
+    # otherwise rejects loudly (per-group statistics with UNPERMUTED keys
+    # are the exact intra-batch leak Shuffle-BN exists to prevent,
+    # `moco/builder.py:~L79-126`). Exists so the BN-cheat positive
+    # control (scripts/ablate_shuffle.py arm 'none' with virtual groups
+    # on one chip) can reproduce the phenomenon deliberately; never set
+    # it in a training recipe.
+    allow_leaky_bn: bool = False
     cifar_stem: bool = False
     compute_dtype: str = "bfloat16"
     # MoCo v3 (queue-free symmetric contrastive): set num_negatives=0,

@@ -72,6 +72,21 @@ def run_arm(arm: str, args) -> dict:
     n_dev = len(jax.devices())
     shuffle = "gather_perm" if arm == "m0" else arm
     momentum = 0.0 if arm == "m0" else args.momentum
+    # --virtual-groups G emulates the G-device per-device-BN topology
+    # inside however many real devices exist (oracle-tested equivalent,
+    # tests/test_resnet.py) — the TPU-single-chip path for this matrix.
+    # syncbn is cross-replica by construction and does not compose.
+    vg = 0 if arm == "syncbn" else args.virtual_groups
+    if vg > 1:
+        per_dev = args.batch // n_dev
+        if per_dev % vg or per_dev // vg < 2:
+            # 1-row groups degenerate (x - mean == 0: BN outputs its bias
+            # and every arm collapses to chance — a silently wrong matrix,
+            # not an error); non-divisible values fail opaquely inside jit
+            raise SystemExit(
+                f"--virtual-groups {vg} needs per-device batch {per_dev} "
+                f"divisible into groups of >= 2 rows"
+            )
     workdir = os.path.join(args.workdir, arm)
     config = TrainConfig(
         moco=MocoConfig(
@@ -84,6 +99,11 @@ def run_arm(arm: str, args) -> dict:
             shuffle=shuffle,
             cifar_stem=True,
             compute_dtype="bfloat16" if jax.default_backend() == "tpu" else "float32",
+            bn_virtual_groups=vg,
+            # the cheat arm NEEDS the leak build_encoder loudly rejects:
+            # per-group statistics with unpermuted keys, opted into
+            # explicitly and only here (this is the positive control)
+            allow_leaky_bn=(arm == "none" and vg > 1),
         ),
         optim=OptimConfig(lr=args.lr, epochs=args.epochs, cos=True, warmup_epochs=1),
         data=DataConfig(
@@ -129,8 +149,16 @@ def run_arm(arm: str, args) -> dict:
         "ema_momentum": momentum,
         "dataset": args.dataset,
         "num_devices": n_dev,
+        "virtual_groups": vg,
         "global_batch": args.batch,
         "per_device_batch": args.batch // n_dev,
+        # rows per BN-statistics group: syncbn spans the whole global
+        # batch; virtual groups split each device's shard into vg groups
+        "bn_group_rows": (
+            args.batch if arm == "syncbn"
+            else args.batch // (n_dev * vg) if vg > 1
+            else args.batch // n_dev
+        ),
         "queue": args.queue,
         "epochs": args.epochs,
         "examples": args.examples,
@@ -167,11 +195,14 @@ def render_section(ablation_dir: str = ABLATION_DIR) -> str | None:
         "",
         f"`scripts/ablate_shuffle.py` on `{any_r['dataset']}` ({any_r['backend']}, "
         f"{any_r['num_devices']} devices, global batch {any_r['global_batch']} = "
-        f"{any_r['per_device_batch']}/device, K={k}, {any_r['epochs']} epochs, "
-        f"seed {any_r['seed']}; identical data/schedule across arms).",
+        f"{any_r['per_device_batch']}/device"
+        + f", K={k}, {any_r['epochs']} epochs, "
+        f"seed {any_r['seed']}; identical data/schedule across arms; "
+        "BN rows/group is per-arm below — syncbn's statistics span the "
+        "whole batch by construction).",
         "",
-        "| Arm | BN decorrelation | EMA m | contrast acc (tail mean) | kNN top-1 (final) |",
-        "|---|---|---|---|---|",
+        "| Arm | BN decorrelation | BN rows/group | EMA m | contrast acc (tail mean) | kNN top-1 (final) |",
+        "|---|---|---|---|---|---|",
     ]
     for arm in ARMS:
         r = results.get(arm)
@@ -185,12 +216,12 @@ def render_section(ablation_dir: str = ABLATION_DIR) -> str | None:
             "m0": "Shuffle-BN, no EMA",
         }[arm]
         knn = r["final_knn_top1"]
+        rows = r.get("bn_group_rows")
+        rows_cell = str(rows) if rows is not None else "—"
+        knn_cell = f"{knn:.2f}%" if knn is not None else "n/a"
         lines.append(
-            f"| `{arm}` | {label} | {r['ema_momentum']} | "
-            f"{r['contrast_acc_tail_mean']:.2f}% | "
-            f"{knn:.2f}% |" if knn is not None else
-            f"| `{arm}` | {label} | {r['ema_momentum']} | "
-            f"{r['contrast_acc_tail_mean']:.2f}% | n/a |"
+            f"| `{arm}` | {label} | {rows_cell} | {r['ema_momentum']} | "
+            f"{r['contrast_acc_tail_mean']:.2f}% | {knn_cell} |"
         )
     lines += [
         "",
@@ -246,6 +277,11 @@ def main() -> None:
     ap.add_argument("--lr", type=float, default=0.03)
     ap.add_argument("--momentum", type=float, default=0.99)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--virtual-groups", type=int, default=0,
+                    help="emulate a G-device per-device-BN topology with "
+                    "BatchNorm virtual groups (runs the matrix on a single "
+                    "TPU chip ~2 orders of magnitude faster than the "
+                    "8-virtual-CPU-device mesh); syncbn arm ignores it")
     ap.add_argument("--report", default="REPORT.md")
     ap.add_argument("--marker", default="ablation",
                     help="report section marker; a second matrix (e.g. on "

@@ -69,7 +69,11 @@ def probe_arm(arm: str, workdir: str, groups, batches: int, batch) -> dict:
     if batch is None:
         batch = config.data.global_batch
     if groups is None:
-        groups = int(extra.get("num_data", 1))
+        # training-time co-batch composition: num_data devices, each
+        # split into bn_virtual_groups virtual groups (if trained so)
+        groups = int(extra.get("num_data", 1)) * max(
+            1, config.moco.bn_virtual_groups
+        )
     if groups < 2:
         raise ValueError(
             f"{arm}: trained on {groups} device(s) with no virtual groups - "
@@ -89,7 +93,12 @@ def probe_arm(arm: str, workdir: str, groups, batches: int, batch) -> dict:
     # arms get plain per-group BN here too: the probe's question is
     # only "does THIS parameter set read co-batch statistics".
     probe_moco = dataclasses.replace(
-        config.moco, shuffle="gather_perm", bn_virtual_groups=groups
+        config.moco, shuffle="gather_perm", bn_virtual_groups=groups,
+        # virtual_groups and stats_rows are mutually exclusive in
+        # BatchNorm; a subset-stats-trained checkpoint is probed with
+        # plain per-group statistics (same question: does THIS parameter
+        # set read co-batch statistics)
+        bn_stats_rows=0,
     )
     probe_encoder = build_encoder(probe_moco)
 

@@ -59,6 +59,10 @@ def main() -> None:
                     help="subset-row BN statistics (accuracy arm of the "
                     "BN-bytes lever; 0 = full-batch stats)")
     args = ap.parse_args()
+    if args.v3 and args.bn_stats_rows:
+        # the v3 config never receives bn_stats_rows (ViT has no BN);
+        # silently recording the lever as active would fake the arm
+        ap.error("--bn-stats-rows is a ResNet BatchNorm lever; not valid with --v3")
     if args.v3 and args.workdir == DEFAULT_WORKDIR:
         # never share the baseline run's workdir: train() would auto-resume
         # the ResNet checkpoint into the ViT template and metrics.jsonl

@@ -190,6 +190,32 @@ class TestSubsetStatsBatchNorm:
         # composition to leak
         build_encoder(cfg, num_data=1)
 
+    def test_allow_leaky_bn_opts_into_the_cheat_config(self):
+        # the BN-cheat positive control (scripts/ablate_shuffle.py arm
+        # 'none' with virtual groups) needs the exact config the gates
+        # reject; allow_leaky_bn=True is the explicit opt-in
+        import pytest
+
+        from moco_tpu.core import build_encoder
+        from moco_tpu.utils.config import MocoConfig
+
+        leaky = MocoConfig(
+            arch="resnet18", shuffle="none", cifar_stem=True,
+            bn_virtual_groups=4,
+        )
+        with pytest.raises(ValueError, match="bn_virtual_groups"):
+            build_encoder(leaky, num_data=1)
+        import dataclasses
+
+        build_encoder(
+            dataclasses.replace(leaky, allow_leaky_bn=True), num_data=1
+        )
+        subset = MocoConfig(
+            arch="resnet18", shuffle="none", cifar_stem=True,
+            bn_stats_rows=2, allow_leaky_bn=True,
+        )
+        build_encoder(subset, num_data=8)
+
     def test_train_step_runs_with_subset_bn(self):
         from moco_tpu.core import build_encoder, create_state, make_train_step, place_state
         from moco_tpu.parallel import create_mesh
