@@ -163,10 +163,18 @@ def main() -> None:
             mlp=True,
             # virtual groups need the in-batch key permutation, so the
             # single-device bench switches to gather_perm when the
-            # BENCH_BN_VIRTUAL_GROUPS A/B leg is active
-            shuffle="gather_perm"
+            # BENCH_BN_VIRTUAL_GROUPS A/B leg is active; the EMAN leg
+            # (BENCH_KEY_BN_EVAL=1) instead REQUIRES shuffle='none'
+            # (running-stats keys have nothing to decorrelate)
+            shuffle="none"
+            if os.environ.get("BENCH_KEY_BN_EVAL") == "1"
+            else "gather_perm"
             if n_dev > 1 or int(os.environ.get("BENCH_BN_VIRTUAL_GROUPS", 0)) > 1
             else "none",
+            # BENCH_KEY_BN_EVAL=1 A/Bs the EMAN-style key forward
+            # (eval-mode BN from EMA'd running stats — drops the key-side
+            # statistics pass, one third of the BN-bytes cost center)
+            key_bn_running_stats=os.environ.get("BENCH_KEY_BN_EVAL") == "1",
             cifar_stem=not on_tpu,
             compute_dtype=dtype,
             # BENCH_BN_STATS_ROWS=32 A/Bs the subset-statistics BN (the
@@ -256,7 +264,8 @@ def main() -> None:
         try:
             from moco_tpu.data.pipeline import TwoCropPipeline
 
-            folder = _ensure_jpeg_folder("/tmp/moco_bench_imgfolder", 1024, 256)
+            n_imgs = 1024
+            folder = _ensure_jpeg_folder("/tmp/moco_bench_imgfolder", n_imgs, 256)
             dconf = DataConfig(
                 dataset="imagefolder",
                 data_dir=folder,
@@ -280,8 +289,15 @@ def main() -> None:
                     yield from pipe.epoch(epoch)
                     epoch += 1
 
+            # warm a FULL first epoch before timing: the first pass over
+            # a cold cache dir decodes every JPEG and writes the packed
+            # cache — a one-time cost that otherwise lands inside the
+            # timed loop and misreports the steady-state rate (the
+            # ladder in PROFILE.md is steady-state)
             it = batches()
-            b0 = next(it)  # warm the aug compile + first decode
+            warm_steps = max(n_imgs // batch, 1)
+            for _ in range(warm_steps):
+                b0 = next(it)
             state, metrics = step(state, b0, root_rng)
             float(metrics["loss"])
             data_steps = 0

@@ -115,6 +115,10 @@ def create_backbone(cfg: MocoConfig, num_data: Optional[int] = None) -> nn.Modul
         and (cfg.shuffle == "none" or cfg.v3)
         and (num_data or 1) > 1
         and not cfg.allow_leaky_bn
+        # with an EMAN key forward the key path reads NO batch
+        # statistics, so query-side subset stats cannot leak key
+        # composition — stacking the two BN levers is safe
+        and not cfg.key_bn_running_stats
     ):
         # same leak logic as the virtual-groups gate below, sharpened:
         # statistics over a FIXED first-r-rows subset leak more than
@@ -321,6 +325,20 @@ def make_train_step(
     (host- or device-side); sharded over the `data` axis.
     """
     cfg = config.moco
+    if cfg.key_bn_running_stats:
+        # before the v3/predictor checks: the flag conflict is the more
+        # fundamental config error and must be the one reported
+        if cfg.v3:
+            raise ValueError(
+                "key_bn_running_stats is a v2-step lever; the v3 step "
+                "manages its own momentum encoder"
+            )
+        if cfg.shuffle in ("gather_perm", "a2a"):
+            raise ValueError(
+                "key_bn_running_stats removes batch statistics from the key "
+                "forward, so Shuffle-BN would be pure wasted communication: "
+                "set shuffle='none' (or 'syncbn' for query-side statistics)"
+            )
     if cfg.v3 and predictor is None:
         raise ValueError("v3=True requires a predictor module (build_predictor)")
     if cfg.v3 and cfg.num_negatives:
@@ -539,7 +557,15 @@ def make_train_step(
             k_local = balanced_unshuffle(step_rng, k_sh, DATA_AXIS)
             k_global = lax.all_gather(k_local, DATA_AXIS).reshape(-1, cfg.dim)
         else:  # 'syncbn' (cross-replica BN handles decorrelation) or 'none'
-            k_local, stats_k = apply_encoder(params_k, state.batch_stats_k, im_k)
+            # key_bn_running_stats (EMAN, config.py rationale): the key
+            # forward runs EVAL-mode BN against the EMA'd running stats —
+            # no statistics pass, no composition leak, no shuffle
+            # collectives; the returned stats tree is unchanged and is
+            # replaced by the EMA advance in (4) below.
+            k_local, stats_k = apply_encoder(
+                params_k, state.batch_stats_k, im_k,
+                train=not cfg.key_bn_running_stats,
+            )
             k_local = l2_normalize(k_local)
             k_global = (
                 lax.all_gather(k_local, DATA_AXIS).reshape(-1, cfg.dim)
@@ -600,7 +626,15 @@ def make_train_step(
         # Running BN stats: average across devices (strictly better than
         # the reference, which checkpoints rank 0's local stats).
         stats_q = lax.pmean(stats_q, DATA_AXIS)
-        stats_k = lax.pmean(stats_k, DATA_AXIS)
+        if cfg.key_bn_running_stats:
+            # the key's running statistics trail the query's on the
+            # params' momentum schedule (EMAN); stats_q is already
+            # pmean'd, so the EMA stays replicated in lockstep
+            stats_k = ema_update(
+                state.batch_stats_k, stats_q, ema_momentum(state.step)
+            )
+        else:
+            stats_k = lax.pmean(stats_k, DATA_AXIS)
 
         # (5) Optimizer update: replicated full update, or — with
         # shard_weight_update — ZeRO-style (parallel/zero.py): the grad

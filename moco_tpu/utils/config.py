@@ -55,6 +55,23 @@ class MocoConfig:
     # on one chip) can reproduce the phenomenon deliberately; never set
     # it in a training recipe.
     allow_leaky_bn: bool = False
+    # Key-encoder BatchNorm from RUNNING statistics (the EMAN recipe,
+    # arXiv:2101.08482, re-derived TPU-first): the key forward runs
+    # eval-mode BN against batch_stats_k, which is EMA-updated each
+    # step toward the query encoder's RUNNING statistics — the
+    # BN-momentum-smoothed buffers, exactly as EMAN tracks buffers,
+    # NOT the step's raw batch mean/var — on the params' momentum
+    # schedule. Three effects on the HBM-bound step
+    # (PROFILE.md: BN statistics reads are 55% of step time, one third
+    # of that on the key forward): the key-side statistics pass
+    # disappears entirely; the BN-composition leak Shuffle-BN exists to
+    # prevent disappears BY CONSTRUCTION (no batch statistics on keys),
+    # so the shuffle collectives go too; and multi-chip key forwards
+    # need zero communication. Changes training semantics vs the
+    # reference recipe — ship only with its accuracy arm (REPORT.md).
+    # Requires shuffle='none' (or 'syncbn' for the query side); the
+    # v2-step lever only (the v3 step has its own momentum encoder).
+    key_bn_running_stats: bool = False
     cifar_stem: bool = False
     compute_dtype: str = "bfloat16"
     # MoCo v3 (queue-free symmetric contrastive): set num_negatives=0,
@@ -254,6 +271,17 @@ PRESETS = {
             optimizer="lars", lr=4.8, weight_decay=1e-6, epochs=200, cos=True, warmup_epochs=10
         ),
         data=DataConfig(dataset="imagefolder", aug_plus=True, global_batch=4096),
+    ),
+    # Beyond-reference TPU-first variant of imagenet_v2: EMAN-style key
+    # forward (key_bn_running_stats, arXiv:2101.08482 pattern) — no
+    # key-side BN statistics pass, no Shuffle-BN collectives, zero-comm
+    # multi-chip key forwards. Semantics differ from the reference
+    # recipe; the accuracy arm lives in REPORT.md before this graduates
+    # to a recommendation.
+    "imagenet_v2_eman": TrainConfig(
+        moco=_v2(MocoConfig(shuffle="none", key_bn_running_stats=True)),
+        optim=OptimConfig(lr=0.03, epochs=200, cos=True),
+        data=DataConfig(dataset="imagefolder", aug_plus=True),
     ),
     # BASELINE.json configs[4]: MoCo v3 ViT-B/16, queue-free symmetric
     # loss, AdamW + warmup (arXiv:2104.02057 recipe: lr=1.5e-4·batch/256,

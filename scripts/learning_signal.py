@@ -58,7 +58,13 @@ def main() -> None:
     ap.add_argument("--bn-stats-rows", type=int, default=0,
                     help="subset-row BN statistics (accuracy arm of the "
                     "BN-bytes lever; 0 = full-batch stats)")
+    ap.add_argument("--key-bn-eval", action="store_true",
+                    help="EMAN-style key forward: eval-mode BN from EMA'd "
+                    "running stats (accuracy arm of the key-stats-pass "
+                    "lever; forces shuffle='none')")
     args = ap.parse_args()
+    if args.v3 and args.key_bn_eval:
+        ap.error("--key-bn-eval is a v2-step lever; not valid with --v3")
     if args.v3 and args.bn_stats_rows:
         # the v3 config never receives bn_stats_rows (ViT has no BN);
         # silently recording the lever as active would fake the arm
@@ -116,9 +122,11 @@ def main() -> None:
             momentum=0.99,  # small dataset: faster EMA than ImageNet's 0.999
             temperature=0.2,
             mlp=True,
-            shuffle="gather_perm" if n_dev > 1 else "none",
+            shuffle="none" if args.key_bn_eval
+            else "gather_perm" if n_dev > 1 else "none",
             cifar_stem=True,
             compute_dtype=dtype,
+            key_bn_running_stats=args.key_bn_eval,
             # VERDICT r3 #2's accuracy arm: the BN-bytes perf lever
             # changes training semantics (stats + their gradients from
             # the first N rows only, models/resnet.py) — a win on step
@@ -224,6 +232,7 @@ def main() -> None:
         "arch": config.moco.arch,
         "v3": args.v3,
         "bn_stats_rows": args.bn_stats_rows,
+        "key_bn_running_stats": args.key_bn_eval,
         "pixel_top1": pixel_top1,
         "probe_metrics": probe_metrics,
         "final_knn": final.get("knn_top1"),

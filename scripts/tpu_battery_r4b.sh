@@ -1,0 +1,69 @@
+#!/bin/bash
+# Part 2 of the round-4 TPU battery — the legs the first run never
+# reached. Lesson from part 1 (artifacts/tpu_r4/battery.log): the
+# bn_stats_rows r50/224 program compiles for >15 min on the TPU
+# backend, the 900 s leg timeout SIGTERMed it mid-compile, and the
+# killed leaseholder wedged the chip lease (bn64's init then hung with
+# an empty log until the battery was stopped by hand). Changes here:
+#   - every leg waits for a HEALTHY backend first (subprocess probe,
+#     abandoned not killed on timeout) instead of serially burning
+#     timeouts against a wedged lease;
+#   - pathological-compile suspects (bn32/bn64/vg8) run LAST with
+#     45-minute timeouts;
+#   - timeouts use SIGKILL only as timeout(1)'s escalation default —
+#     the point is they should never fire on a healthy leg.
+set -u
+cd "$(dirname "$0")/.."
+L=artifacts/tpu_r4
+mkdir -p "$L"
+date > "$L/battery_b_started"
+
+wait_backend() {
+  until python - <<'EOF'
+import sys
+sys.path.insert(0, ".")
+from moco_tpu.utils.platform import backend_usable
+sys.exit(0 if backend_usable(timeout=150) else 1)
+EOF
+  do
+    echo "backend not usable; waiting 180s ($(date +%H:%M:%S))" | tee -a "$L/battery.log"
+    sleep 180
+  done
+}
+
+run() { # name timeout_s env... -- cmd...
+  local name=$1 t=$2; shift 2
+  local envs=()
+  while [ "$1" != "--" ]; do envs+=("$1"); shift; done
+  shift
+  wait_backend
+  echo "=== $name ($(date +%H:%M:%S)) ===" | tee -a "$L/battery.log"
+  env "${envs[@]}" timeout "$t" "$@" > "$L/$name.out" 2> "$L/$name.log"
+  echo "rc=$? $name" | tee -a "$L/battery.log"
+}
+
+# ViT v3 step bench, flash off/on (battery item 4)
+run bench_vit 2700 BENCH_ARCH=vit_b16 BENCH_SKIP_DATA=1 -- python bench.py
+run bench_vit_flash 2700 BENCH_ARCH=vit_b16 BENCH_FLASH=1 BENCH_SKIP_DATA=1 -- python bench.py
+
+# compiled (non-interpret) Pallas kernel tests
+run kernel_tests 2700 MOCO_TPU_TESTS=1 -- python -m pytest tests/test_tpu_kernels.py -q
+
+# TPU-tunnel host->device transfer anchor (input-path evidence)
+rm -rf /tmp/moco_input_profile_cache
+run input_transfer 1800 -- python scripts/profile_input.py --batch 64 --n-images 1024 \
+  --reps 2 --threads 1 --out-size 224 --src-size 256 \
+  --profile-md artifacts/tpu_r4/input_profile_tpu.md --artifact artifacts/tpu_r4/input_profile_tpu.json
+
+# EMAN key forward A/B (key_bn_running_stats): drops the key-side BN
+# statistics pass — one third of the 55%-of-step BN-bytes cost center
+# (PROFILE.md). Expected to COMPILE FINE (it removes reduces).
+run bench_r50_eman 2700 BENCH_SKIP_DATA=1 BENCH_KEY_BN_EVAL=1 -- python bench.py
+
+# BN-bytes lever A/Bs — the slow-compile suspects, LAST, 45 min each
+run bench_r50_bn32 2700 BENCH_SKIP_DATA=1 BENCH_BN_STATS_ROWS=32 -- python bench.py
+run bench_r50_bn64 2700 BENCH_SKIP_DATA=1 BENCH_BN_STATS_ROWS=64 -- python bench.py
+run bench_r50_vg8 2700 BENCH_SKIP_DATA=1 BENCH_BN_VIRTUAL_GROUPS=8 -- python bench.py
+
+date > "$L/battery_b_finished"
+echo "battery part 2 complete" | tee -a "$L/battery.log"

@@ -1,0 +1,95 @@
+#!/bin/bash
+# Round-4 TPU learning-chain battery (VERDICT r3 items 2, 3, 5, 6, 7)
+# — run AFTER scripts/tpu_battery.sh (the TPU admits ONE client).
+# Sequential; every leg is resumable (arm JSONs / checkpoints skip
+# finished work). Ordered by verdict priority so a mid-run tunnel
+# death leaves the most important evidence behind first.
+#
+# Output: artifacts/tpu_chains_r4/*.log + per-leg artifact dirs.
+set -u
+cd "$(dirname "$0")/.."
+L=artifacts/tpu_chains_r4
+mkdir -p "$L"
+date > "$L/chains_started"
+
+run() { # name timeout_s -- cmd...
+  local name=$1 t=$2; shift 2; shift # consume "--"
+  echo "=== $name ($(date +%H:%M:%S)) ===" | tee -a "$L/chains.log"
+  timeout "$t" "$@" > "$L/$name.out" 2> "$L/$name.log"
+  echo "rc=$? $name" | tee -a "$L/chains.log"
+}
+
+# 1. the 32-class gate at the headline chain's budget (VERDICT r3 #3:
+#    "budget is binding" is the claim under test — 30 ep x 4096 ex x
+#    batch 256 is ~12x the CI budget where every variant failed).
+#    Scratch report: the hard-signal REPORT.md section is folded by
+#    hand from signal_summary.json (the main body belongs to the
+#    8-class headline chain).
+run signal32 7200 -- python scripts/learning_signal.py \
+  --dataset synthetic_learnable32 --epochs 30 --batch 256 \
+  --examples 4096 --queue 4096 \
+  --workdir /tmp/moco_signal32_tpu --report "$L/signal32_report.md"
+
+# 2. headline 8-class chain ON TPU — the platform upgrade of the main
+#    REPORT.md body (until now CPU-only) and the CONTROL arm for the
+#    bn_stats_rows accuracy comparison (identical budget + platform).
+run signal8 7200 -- python scripts/learning_signal.py \
+  --epochs 30 --batch 256 --examples 4096 --queue 4096 \
+  --workdir /tmp/moco_signal8_tpu --report REPORT.md
+
+# 3. the BN-bytes lever's accuracy arm (VERDICT r3 #2): same budget,
+#    statistics from the first 32 of 256 rows. A step-time win that
+#    degrades the probe is not a win; this is the degradation check.
+run signal8_bn32 7200 -- python scripts/learning_signal.py \
+  --epochs 30 --batch 256 --examples 4096 --queue 4096 --bn-stats-rows 32 \
+  --workdir /tmp/moco_signal8_bn32_tpu --report "$L/bn32_report.md"
+
+# 3b. the EMAN lever's accuracy arm: key forward on eval-mode BN with
+#     EMA'd running stats (key_bn_running_stats) at the same budget —
+#     companion to the BENCH_KEY_BN_EVAL step-time A/B.
+run signal8_eman 7200 -- python scripts/learning_signal.py \
+  --epochs 30 --batch 256 --examples 4096 --queue 4096 --key-bn-eval \
+  --workdir /tmp/moco_signal8_eman_tpu --report "$L/eman_report.md"
+
+# 4. BN-cheat positive control (VERDICT r3 #5): the leak-control task
+#    (weak global tint, iid noise otherwise), geometric-only crops,
+#    2-row BN groups (batch 64 / 32 virtual groups — the corr-0.74
+#    fingerprint regime), 30 epochs. Arm 'none' opts into the leak
+#    via allow_leaky_bn; gather_perm/a2a must remove it.
+run leak_ablate 10800 -- python scripts/ablate_shuffle.py \
+  --arms none gather_perm a2a --dataset synthetic_leak_control --crops-only \
+  --virtual-groups 32 --batch 64 --examples 2048 --queue 2048 \
+  --epochs 30 --knn-every 5 \
+  --workdir /tmp/moco_leak_tpu --out artifacts/leak_control \
+  --marker ablation-leak
+
+# 5. mechanism probe on those checkpoints: aligned-vs-shuffled contrast
+#    accuracy under the trained 2-row grouping (the sharper instrument;
+#    arm 'none' should finally show a drop, the honest arms ~0)
+run leak_probe 3600 -- python scripts/leak_probe.py \
+  --arms none gather_perm a2a --workdir /tmp/moco_leak_tpu \
+  --batches 8 --out artifacts/leak_probe_control.json \
+  --marker leak-probe-control
+
+# 6. v3/ViT at larger-than-tiny scale (VERDICT r3 #6): vit_s16
+#    (384-wide, 12-deep) on the TPU chip, same budget as the headline
+#    chain; replaces the vit_tiny/CPU v3-signal section in REPORT.md.
+run v3_vit_s16 10800 -- python scripts/learning_signal.py \
+  --v3 --arch vit_s16 --epochs 30 --batch 256 --examples 4096 \
+  --workdir /tmp/moco_signal_v3s16_tpu --report REPORT.md
+
+# 7. LARS large-batch path (VERDICT r3 #7): one measured data point,
+#    batch 512, LARS vs SGD, same budget; writes the lars-check
+#    REPORT.md section with median step time per arm.
+run lars 7200 -- python scripts/lars_check.py
+
+# durable copies of the /tmp run summaries (workdirs are scratch)
+for d in moco_signal32_tpu moco_signal8_tpu moco_signal8_bn32_tpu \
+         moco_signal_v3s16_tpu; do
+  for f in signal_summary.json signal_summary_v3.json metrics.jsonl; do
+    [ -f "/tmp/$d/$f" ] && cp "/tmp/$d/$f" "$L/${d}_${f}"
+  done
+done
+
+date > "$L/chains_finished"
+echo "chains complete" | tee -a "$L/chains.log"

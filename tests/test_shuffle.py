@@ -5,6 +5,7 @@ the reference gets from NCCL broadcast + all_gather, moco/builder.py:~L79-126)."
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import PartitionSpec as P
 
 from moco_tpu.parallel import (
@@ -113,3 +114,62 @@ def test_permutation_is_deterministic_per_seed():
     p2, _ = make_permutation(jax.random.key(7), 32)
     np.testing.assert_array_equal(np.asarray(p1), np.asarray(p2))
     np.testing.assert_array_equal(np.asarray(p1)[np.asarray(i1)], np.arange(32))
+
+
+@pytest.mark.slow
+def test_leak_control_cheat_arm_trains_and_probes(tmp_path):
+    """The BN-cheat positive-control pipeline end-to-end at smoke scale:
+    the cheat config (shuffle='none' + virtual per-group BN, opted in
+    via allow_leaky_bn) must train on `synthetic_leak_control`, and the
+    leak probe must resolve the virtual grouping from the checkpoint by
+    default and produce finite aligned/shuffled accuracies. Guards the
+    single-chip path scripts/tpu_chains_r4.sh runs at full budget."""
+    import importlib.util
+    import os
+
+    import numpy as np
+
+    from moco_tpu.data.datasets import build_dataset
+    from moco_tpu.train import train
+    from moco_tpu.utils.config import (
+        DataConfig,
+        MocoConfig,
+        OptimConfig,
+        ParallelConfig,
+        TrainConfig,
+    )
+
+    workdir = str(tmp_path / "none")
+    config = TrainConfig(
+        moco=MocoConfig(
+            arch="resnet18", dim=32, num_negatives=64, momentum=0.9,
+            temperature=0.2, mlp=True, shuffle="none", cifar_stem=True,
+            compute_dtype="float32", bn_virtual_groups=4,
+            allow_leaky_bn=True,
+        ),
+        optim=OptimConfig(lr=0.06, epochs=1, cos=True),
+        data=DataConfig(
+            dataset="synthetic_leak_control", image_size=32,
+            global_batch=16, aug_plus=True, crops_only=True,
+        ),
+        parallel=ParallelConfig(num_data=1),
+        workdir=workdir,
+        knn_every_epochs=0,
+        seed=0,
+    )
+    dataset = build_dataset("synthetic_leak_control", None, 32, train=True)
+    dataset.num_examples = 64
+    final = train(config, dataset=dataset)
+    assert np.isfinite(final["loss"])
+
+    spec = importlib.util.spec_from_file_location(
+        "leak_probe",
+        os.path.join(os.path.dirname(__file__), "..", "scripts", "leak_probe.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    # groups=None: must resolve to num_data (1) x bn_virtual_groups (4)
+    result = mod.probe_arm("none", workdir, None, batches=2, batch=None)
+    assert result["groups"] == 4
+    assert np.isfinite(result["contrast_acc_aligned"])
+    assert np.isfinite(result["acc_drop_when_decorrelated"])

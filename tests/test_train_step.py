@@ -197,3 +197,51 @@ def test_queue_wraps_over_epochs():
     for i in range(K // BATCH + 1):
         state, _ = step(state, make_batch(i), jax.random.key(1))
     assert int(state.queue_ptr) == BATCH  # wrapped past K
+
+
+class TestKeyBnRunningStats:
+    """EMAN-style key forward (MocoConfig.key_bn_running_stats): the key
+    encoder runs eval-mode BN, its running statistics EMA-track the
+    query's, and the incompatible-config gates fail loudly."""
+
+    def test_step_runs_and_stats_track_query(self):
+        config = tiny_config(shuffle="none", key_bn_running_stats=True, momentum=0.9)
+        _, _, _, state, step = setup(config)
+        k_stats0 = jax.tree.map(np.array, state.batch_stats_k)
+        state, metrics = step(state, make_batch(), jax.random.key(1))
+        assert np.isfinite(float(metrics["loss"]))
+        # batch_stats_k must be EXACTLY the EMA of its old value toward
+        # the new (pmean'd) query statistics — the lockstep invariant
+        expected = jax.tree.map(
+            lambda old, q: 0.9 * old + 0.1 * np.asarray(q),
+            k_stats0,
+            jax.tree.map(np.array, state.batch_stats_q),
+        )
+        chex = jax.tree.map(
+            lambda a, b: np.allclose(a, b, rtol=1e-5, atol=1e-6),
+            expected,
+            jax.tree.map(np.array, state.batch_stats_k),
+        )
+        assert all(jax.tree.leaves(chex))
+
+    def test_syncbn_composes(self):
+        """shuffle='syncbn' is the allowed multi-device companion: the
+        query side keeps cross-replica statistics while the key side
+        stays on running stats."""
+        config = tiny_config(shuffle="syncbn", key_bn_running_stats=True)
+        _, _, _, state, step = setup(config)
+        _, metrics = step(state, make_batch(), jax.random.key(1))
+        assert np.isfinite(float(metrics["loss"]))
+
+    def test_rejected_with_shuffle_or_v3(self):
+        for bad in ("gather_perm", "a2a"):
+            config = tiny_config(shuffle=bad, key_bn_running_stats=True)
+            with pytest.raises(ValueError, match="key_bn_running_stats"):
+                setup(config)
+        config = tiny_config(shuffle="none", key_bn_running_stats=True)
+        config = dataclasses.replace(
+            config,
+            moco=dataclasses.replace(config.moco, v3=True, num_negatives=0),
+        )
+        with pytest.raises(ValueError, match="key_bn_running_stats"):
+            setup(config)

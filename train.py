@@ -56,6 +56,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="virtual Shuffle-BN: per-group BN statistics over G row-groups "
         "+ in-batch key permutation — the reference's G-GPU recipe on one chip",
     )
+    p.add_argument(
+        "--key-bn-eval", dest="key_bn_running_stats", action="store_true",
+        default=None,
+        help="EMAN-style key forward: eval-mode BN from EMA'd running "
+        "statistics — drops the key-side BN stats pass and the Shuffle-BN "
+        "collectives (requires --shuffle none or syncbn; see "
+        "imagenet_v2_eman preset)",
+    )
     # ViT options (moco-v3 family)
     p.add_argument(
         "--v3", action="store_true", default=None,
@@ -150,6 +158,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         shuffle=args.shuffle,
         bn_stats_rows=args.bn_stats_rows,
         bn_virtual_groups=args.bn_virtual_groups,
+        key_bn_running_stats=args.key_bn_running_stats,
         v3=args.v3,
         momentum_cos=args.moco_m_cos,
         vit_pool=args.vit_pool,
