@@ -10,8 +10,11 @@
 #     timeouts against a wedged lease;
 #   - pathological-compile suspects (bn32/bn64/vg8) run LAST with
 #     45-minute timeouts;
-#   - timeouts use SIGKILL only as timeout(1)'s escalation default —
-#     the point is they should never fire on a healthy leg.
+#   - timeouts escalate SIGTERM -> SIGKILL (-k 60): part 1's failure
+#     mode was a leg wedged in C++ TPU-runtime threads that survives
+#     SIGTERM — without escalation the battery would hang on it
+#     forever. The point remains that they should never fire on a
+#     healthy leg.
 set -u
 cd "$(dirname "$0")/.."
 L=artifacts/tpu_r4
@@ -38,7 +41,7 @@ run() { # name timeout_s env... -- cmd...
   shift
   wait_backend
   echo "=== $name ($(date +%H:%M:%S)) ===" | tee -a "$L/battery.log"
-  env "${envs[@]}" timeout "$t" "$@" > "$L/$name.out" 2> "$L/$name.log"
+  env "${envs[@]}" timeout -k 60 "$t" "$@" > "$L/$name.out" 2> "$L/$name.log"
   echo "rc=$? $name" | tee -a "$L/battery.log"
 }
 

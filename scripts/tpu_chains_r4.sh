@@ -15,7 +15,7 @@ date > "$L/chains_started"
 run() { # name timeout_s -- cmd...
   local name=$1 t=$2; shift 2; shift # consume "--"
   echo "=== $name ($(date +%H:%M:%S)) ===" | tee -a "$L/chains.log"
-  timeout "$t" "$@" > "$L/$name.out" 2> "$L/$name.log"
+  timeout -k 60 "$t" "$@" > "$L/$name.out" 2> "$L/$name.log"
   echo "rc=$? $name" | tee -a "$L/chains.log"
 }
 
@@ -85,7 +85,7 @@ run lars 7200 -- python scripts/lars_check.py
 
 # durable copies of the /tmp run summaries (workdirs are scratch)
 for d in moco_signal32_tpu moco_signal8_tpu moco_signal8_bn32_tpu \
-         moco_signal_v3s16_tpu; do
+         moco_signal8_eman_tpu moco_signal_v3s16_tpu; do
   for f in signal_summary.json signal_summary_v3.json metrics.jsonl; do
     [ -f "/tmp/$d/$f" ] && cp "/tmp/$d/$f" "$L/${d}_${f}"
   done
