@@ -138,6 +138,10 @@ def create_backbone(cfg: MocoConfig, num_data: Optional[int] = None) -> nn.Modul
         cfg.bn_virtual_groups > 1
         and (cfg.shuffle == "none" or cfg.v3)
         and not cfg.allow_leaky_bn
+        # EMAN key forward: the key path reads NO batch statistics, so
+        # query-side per-group stats cannot leak key composition (same
+        # exemption as the bn_stats_rows gate above)
+        and not cfg.key_bn_running_stats
     ):
         # must fail loudly: per-group BN with UNPERMUTED keys is the exact
         # intra-batch statistics leak Shuffle-BN exists to prevent — worse

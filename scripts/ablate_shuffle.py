@@ -52,7 +52,7 @@ pin_platform_from_env()
 
 ABLATION_DIR = "artifacts/ablation"
 
-ARMS = ("none", "gather_perm", "a2a", "syncbn", "m0")
+ARMS = ("none", "gather_perm", "a2a", "syncbn", "m0", "eman")
 
 
 def run_arm(arm: str, args) -> dict:
@@ -70,12 +70,18 @@ def run_arm(arm: str, args) -> dict:
     )
 
     n_dev = len(jax.devices())
-    shuffle = "gather_perm" if arm == "m0" else arm
+    # 'm0' isolates the EMA encoder on the reference shuffle; 'eman'
+    # replaces Shuffle-BN entirely with the running-stats key forward
+    # (key_bn_running_stats) — its accuracy arm at this budget
+    shuffle = "gather_perm" if arm == "m0" else "none" if arm == "eman" else arm
     momentum = 0.0 if arm == "m0" else args.momentum
     # --virtual-groups G emulates the G-device per-device-BN topology
     # inside however many real devices exist (oracle-tested equivalent,
     # tests/test_resnet.py) — the TPU-single-chip path for this matrix.
     # syncbn is cross-replica by construction and does not compose.
+    # eman keeps vg on its QUERY side so the matrix stays
+    # single-variable (its key path reads no batch statistics either
+    # way; the encoder gate exempts key_bn_running_stats).
     vg = 0 if arm == "syncbn" else args.virtual_groups
     if vg > 1:
         per_dev = args.batch // n_dev
@@ -104,6 +110,7 @@ def run_arm(arm: str, args) -> dict:
             # per-group statistics with unpermuted keys, opted into
             # explicitly and only here (this is the positive control)
             allow_leaky_bn=(arm == "none" and vg > 1),
+            key_bn_running_stats=(arm == "eman"),
         ),
         optim=OptimConfig(lr=args.lr, epochs=args.epochs, cos=True, warmup_epochs=1),
         data=DataConfig(
@@ -187,6 +194,21 @@ def render_section(ablation_dir: str = ABLATION_DIR) -> str | None:
     if not results:
         return None
     any_r = next(iter(results.values()))
+    # a re-run at different flags silently skips finished arms, so a
+    # mixed-budget table is easy to produce by accident — and its
+    # header would then claim "identical data/schedule across arms"
+    # over arms trained on different budgets. Fail loudly instead.
+    budgets = {
+        (r["epochs"], r["examples"], r["global_batch"], r["queue"],
+         r.get("virtual_groups", 0))
+        for r in results.values()
+    }
+    if len(budgets) != 1:
+        raise ValueError(
+            f"arm JSONs in {ablation_dir} were produced at different "
+            f"budgets {sorted(budgets)} — delete the stale ones (or use "
+            "a separate --out dir) before rendering one table"
+        )
     k = any_r["queue"]
     contrast_chance = 100.0 / (1 + k)
     chance = 100.0 / 32 if any_r["dataset"] == "synthetic_hard" else 100.0 / 8
@@ -214,6 +236,7 @@ def render_section(ablation_dir: str = ABLATION_DIR) -> str | None:
             "a2a": "balanced all_to_all",
             "syncbn": "cross-replica BN",
             "m0": "Shuffle-BN, no EMA",
+            "eman": "EMAN key (running-stats BN, no shuffle)",
         }[arm]
         knn = r["final_knn_top1"]
         rows = r.get("bn_group_rows")

@@ -22,12 +22,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-ARMS = ("gather_perm", "a2a", "syncbn")
-LABELS = {
-    "gather_perm": "Shuffle-BN (reference-exact)",
-    "a2a": "balanced all_to_all",
-    "syncbn": "cross-replica BN",
-}
+ARMS = ("gather_perm", "a2a", "syncbn", "eman")
 
 
 def collect(base_dir: str = "artifacts") -> dict[str, list[dict]]:
