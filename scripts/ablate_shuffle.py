@@ -199,15 +199,22 @@ def render_section(ablation_dir: str = ABLATION_DIR) -> str | None:
     # header would then claim "identical data/schedule across arms"
     # over arms trained on different budgets. Fail loudly instead.
     budgets = {
-        (r["epochs"], r["examples"], r["global_batch"], r["queue"],
-         r.get("virtual_groups", 0))
+        (r["epochs"], r["examples"], r["global_batch"], r["queue"])
         for r in results.values()
     }
-    if len(budgets) != 1:
+    # vg is intentionally per-arm (syncbn forces 0), so check it only
+    # across the arms that accept it
+    vgs = {
+        r.get("virtual_groups", 0)
+        for r in results.values()
+        if r["arm"] != "syncbn"
+    }
+    if len(budgets) != 1 or len(vgs) > 1:
         raise ValueError(
             f"arm JSONs in {ablation_dir} were produced at different "
-            f"budgets {sorted(budgets)} — delete the stale ones (or use "
-            "a separate --out dir) before rendering one table"
+            f"budgets {sorted(budgets)} / virtual_groups {sorted(vgs)} — "
+            "delete the stale ones (or use a separate --out dir) before "
+            "rendering one table"
         )
     k = any_r["queue"]
     contrast_chance = 100.0 / (1 + k)

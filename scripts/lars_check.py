@@ -98,9 +98,15 @@ def run_arm(optimizer: str, args) -> dict:
         rows = [json.loads(line) for line in f if line.strip()]
     losses = [(r["step"], r["loss"]) for r in rows if "loss" in r]
     knns = [(r["epoch"], r["knn_top1"]) for r in rows if "knn_top1" in r]
-    # wall-clock per step from the meter's own 'time' column; drop the
-    # first epoch (compile + warmup) before taking the median
-    times = [r["time"] for r in rows if "time" in r and r.get("step", 0) > args.examples // args.batch]
+    # wall-clock per step: the JSONL 'time' column is an absolute
+    # timestamp per logged step (log_every=1 here), so per-step wall
+    # time is the DIFF of consecutive stamps; drop the first epoch
+    # (compile + warmup) before taking the median
+    stamps = [
+        r["time"] for r in rows
+        if "time" in r and r.get("step", 0) > args.examples // args.batch
+    ]
+    times = [b - a for a, b in zip(stamps, stamps[1:])]
     return {
         "optimizer": optimizer,
         "lr": lr,

@@ -53,20 +53,35 @@ def render_section(results: dict[str, list[dict]]) -> str | None:
     present = {a: rs for a, rs in results.items() if rs}
     if not present:
         return None
+    # pool only the majority budget: a stray arm produced at different
+    # flags must not block regeneration of the whole table — it is
+    # dropped and named instead
+    from collections import Counter
+
+    budget_of = lambda r: (  # noqa: E731
+        r["epochs"], r["examples"], r["global_batch"], r["queue"]
+    )
+    counts = Counter(budget_of(r) for rs in present.values() for r in rs)
+    majority = counts.most_common(1)[0][0]
+    excluded = []
+    for arm in list(present):
+        keep = [r for r in present[arm] if budget_of(r) == majority]
+        dropped = [r for r in present[arm] if budget_of(r) != majority]
+        excluded += [f"{arm}/s{r['seed']} @ {budget_of(r)}" for r in dropped]
+        if keep:
+            present[arm] = keep
+        else:
+            del present[arm]
+    if not present:
+        return None
     any_rs = next(iter(present.values()))
-    budgets = {
-        (r["epochs"], r["examples"], r["global_batch"], r["queue"])
-        for rs in present.values()
-        for r in rs
-    }
-    if len(budgets) != 1:
-        raise ValueError(f"mixed budgets across seed runs: {budgets}")
-    e, n, b, k = budgets.pop()
+    e, n, b, k = majority
+    seeds_union = sorted({r["seed"] for rs in present.values() for r in rs})
     lines = [
         "## Shuffle-mode ablation: seed variance",
         "",
         f"`scripts/seed_variance_report.py`: pooled over seeds "
-        f"{[r['seed'] for r in any_rs]} at the identical budget "
+        f"{seeds_union} at the identical budget "
         f"({e} epochs, {n} examples, batch {b}, K={k}, "
         f"`{any_rs[0]['dataset']}`, {any_rs[0]['num_devices']}-device CPU "
         "mesh). mean ± half-range (min–max shown); the question is "
@@ -87,9 +102,13 @@ def render_section(results: dict[str, list[dict]]) -> str | None:
         per_seed = ", ".join(
             f"s{r['seed']}: {v:.1f}" for r, v in zip(rs, knn)
         )
+        spread = (
+            f"{knn.mean():.2f} ± {(knn.max() - knn.min()) / 2:.2f}"
+            if len(knn) > 1
+            else f"{knn.mean():.2f} (n=1 seed, no variance estimate)"
+        )
         lines.append(
-            f"| `{arm}` | {knn.mean():.2f} ± {(knn.max() - knn.min()) / 2:.2f} | "
-            f"{per_seed} | {tail.mean():.2f}% |"
+            f"| `{arm}` | {spread} | {per_seed} | {tail.mean():.2f}% |"
         )
     verdict_line = None
     if "gather_perm" in stats and "a2a" in stats and len(stats["a2a"]) >= 3:
@@ -113,6 +132,12 @@ def render_section(results: dict[str, list[dict]]) -> str | None:
             )
     if verdict_line:
         lines += ["", verdict_line]
+    if excluded:
+        lines += [
+            "",
+            "Excluded from pooling (produced at a different budget than "
+            f"the majority {majority}): {', '.join(excluded)}.",
+        ]
     return "\n".join(lines)
 
 
