@@ -29,3 +29,15 @@ import jax
 if not os.environ.get("MOCO_TPU_TESTS"):
     jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
+
+
+def load_script(name: str):
+    """Import a module from scripts/ by filename (they are not a
+    package); shared by tests that exercise script-level entry points."""
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(__file__), "..", "scripts", name)
+    spec = importlib.util.spec_from_file_location(name.removesuffix(".py"), path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod

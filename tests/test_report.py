@@ -68,3 +68,37 @@ def test_orphan_begin_without_end_raises(tmp_path):
     with pytest.raises(ValueError, match="unbalanced"):
         replace_marker_block(p, "abl", "new")
     assert "truncated" in open(p).read()  # file untouched on error
+
+
+def test_seed_variance_pools_majority_budget_and_names_strays():
+    """A stray arm produced at different flags must not block table
+    regeneration: it is dropped from pooling and named in the section;
+    single-seed arms render without a fake variance estimate."""
+    from tests.conftest import load_script
+
+    svr = load_script("seed_variance_report.py")
+
+    def arm(name, seed, epochs=10, knn=50.0):
+        return {
+            "arm": name, "seed": seed, "epochs": epochs, "examples": 1024,
+            "global_batch": 64, "queue": 2048, "num_devices": 8,
+            "dataset": "synthetic_learnable", "final_knn_top1": knn,
+            "contrast_acc_tail_mean": 10.0,
+        }
+
+    results = {
+        "gather_perm": [arm("gather_perm", 0, knn=53.0),
+                        arm("gather_perm", 1, knn=54.0)],
+        "a2a": [arm("a2a", 0, knn=51.0),
+                # stray: different budget — must be excluded by name
+                arm("a2a", 1, epochs=12, knn=99.0)],
+        "syncbn": [],
+        # single seed: no variance estimate may be claimed
+        "eman": [arm("eman", 0, knn=35.0)],
+    }
+    section = svr.render_section(results)
+    assert "Excluded from pooling" in section and "a2a/s1" in section
+    assert "99.0" not in section  # the stray's kNN never enters the table
+    assert "n=1 seed, no variance estimate" in section
+    # header reports the true pooled seed union
+    assert "[0, 1]" in section

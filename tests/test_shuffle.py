@@ -124,9 +124,6 @@ def test_leak_control_cheat_arm_trains_and_probes(tmp_path):
     leak probe must resolve the virtual grouping from the checkpoint by
     default and produce finite aligned/shuffled accuracies. Guards the
     single-chip path scripts/tpu_chains_r4.sh runs at full budget."""
-    import importlib.util
-    import os
-
     import numpy as np
 
     from moco_tpu.data.datasets import build_dataset
@@ -162,12 +159,9 @@ def test_leak_control_cheat_arm_trains_and_probes(tmp_path):
     final = train(config, dataset=dataset)
     assert np.isfinite(final["loss"])
 
-    spec = importlib.util.spec_from_file_location(
-        "leak_probe",
-        os.path.join(os.path.dirname(__file__), "..", "scripts", "leak_probe.py"),
-    )
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
+    from tests.conftest import load_script
+
+    mod = load_script("leak_probe.py")
     # groups=None: must resolve to num_data (1) x bn_virtual_groups (4)
     result = mod.probe_arm("none", workdir, None, batches=2, batch=None)
     assert result["groups"] == 4
