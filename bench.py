@@ -104,7 +104,11 @@ def _ensure_jpeg_folder(root: str, n: int, size: int, classes: int = 8) -> str:
 
 
 def main() -> None:
-    from moco_tpu.utils.platform import backend_usable, pin_platform_from_env
+    from moco_tpu.utils.platform import (
+        backend_usable,
+        enable_persistent_compilation_cache,
+        pin_platform_from_env,
+    )
 
     pin_platform_from_env()  # honor an explicit JAX_PLATFORMS request
     # A bench that crashes or hangs on a down/wedged tunnel emits NO
@@ -114,6 +118,10 @@ def main() -> None:
         jax.config.update("jax_platforms", "cpu")
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
+    if on_tpu:
+        # AFTER the fallback decision on purpose: the degraded CPU smoke
+        # must not write XLA:CPU AOT entries (see the guard's docstring)
+        enable_persistent_compilation_cache()  # battery legs share compiles
 
     from moco_tpu.core import (
         build_encoder,
@@ -218,6 +226,47 @@ def main() -> None:
         jax.random.PRNGKey(2), jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
     )
 
+    # ---- fused-vs-dense numerics cross-check (BENCH_NUMERICS=1) -------
+    # One compiled step per path from the IDENTICAL initial state and
+    # batch. The streaming Pallas InfoNCE is default-ON for TPU
+    # (core/moco.py fused auto-resolution); a Mosaic lowering bug there
+    # would corrupt training silently while benching fast — this prints
+    # on-chip correctness evidence without needing the pytest session.
+    # Opt-in (two extra full-step compiles, ~2×3.5 min on the chip).
+    if (
+        os.environ.get("BENCH_NUMERICS") == "1"
+        and not is_vit
+        and moco.num_negatives > 0
+    ):
+        import dataclasses
+
+        outs = {}
+        for name, fused in (("fused", True), ("dense", False)):
+            cfg_n = dataclasses.replace(
+                config, moco=dataclasses.replace(moco, fused_infonce=fused)
+            )
+            step_n = make_train_step(
+                cfg_n, encoder, tx, mesh, donate=False,
+                total_steps=5004 * config.optim.epochs,
+            )
+            _, m = step_n(state, batch_dict, root_rng)
+            outs[name] = (float(m["loss"]), float(m["acc1"]))
+        d_loss = abs(outs["fused"][0] - outs["dense"][0])
+        d_acc = abs(outs["fused"][1] - outs["dense"][1])
+        # Both paths share the (bf16) encoder forwards bit-for-bit; they
+        # differ only in the logits/log-sum-exp arithmetic (f32 in both),
+        # so tolerance is tight relative to the ~ln(1+K)≈11 loss scale.
+        ok = d_loss <= 5e-2 and d_acc <= 1.0
+        print(
+            "numerics crosscheck: "
+            f"fused loss={outs['fused'][0]:.6f} acc1={outs['fused'][1]:.3f} "
+            f"dense loss={outs['dense'][0]:.6f} acc1={outs['dense'][1]:.3f} "
+            f"dloss={d_loss:.2e} dacc1={d_acc:.3f} {'PASS' if ok else 'FAIL'}",
+            file=sys.stderr,
+        )
+        if not ok:
+            raise SystemExit("fused-vs-dense numerics crosscheck FAILED")
+
     # Warmup (compile) + steady state. NB: sync via a host transfer, not
     # block_until_ready — on the experimental axon TPU platform
     # block_until_ready returns before device completion (measured: 20 R50
@@ -264,7 +313,9 @@ def main() -> None:
         try:
             from moco_tpu.data.pipeline import TwoCropPipeline
 
-            n_imgs = 1024
+            # drop-last pipeline: an epoch smaller than one batch yields
+            # ZERO batches and the epoch roller below would spin forever
+            n_imgs = max(1024, batch)
             folder = _ensure_jpeg_folder("/tmp/moco_bench_imgfolder", n_imgs, 256)
             dconf = DataConfig(
                 dataset="imagefolder",

@@ -49,9 +49,10 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main() -> None:
     args = build_parser().parse_args()
-    from moco_tpu.utils.platform import pin_platform_from_env
+    from moco_tpu.utils.platform import enable_persistent_compilation_cache, pin_platform_from_env
 
     pin_platform_from_env()
+    enable_persistent_compilation_cache()
     probe = ProbeConfig(
         lr=args.lr,
         momentum=args.momentum,

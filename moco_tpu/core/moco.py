@@ -117,8 +117,12 @@ def create_backbone(cfg: MocoConfig, num_data: Optional[int] = None) -> nn.Modul
         and not cfg.allow_leaky_bn
         # with an EMAN key forward the key path reads NO batch
         # statistics, so query-side subset stats cannot leak key
-        # composition — stacking the two BN levers is safe
-        and not cfg.key_bn_running_stats
+        # composition — stacking the two BN levers is safe. The
+        # exemption must not extend to v3: key_bn_running_stats is
+        # invalid there (make_train_step rejects the combo), so a
+        # v3 config carrying it must still hit this gate rather
+        # than silently building a leaky encoder.
+        and not (cfg.key_bn_running_stats and not cfg.v3)
     ):
         # same leak logic as the virtual-groups gate below, sharpened:
         # statistics over a FIXED first-r-rows subset leak more than
@@ -140,8 +144,8 @@ def create_backbone(cfg: MocoConfig, num_data: Optional[int] = None) -> nn.Modul
         and not cfg.allow_leaky_bn
         # EMAN key forward: the key path reads NO batch statistics, so
         # query-side per-group stats cannot leak key composition (same
-        # exemption as the bn_stats_rows gate above)
-        and not cfg.key_bn_running_stats
+        # exemption — and same v3 scoping — as the bn_stats_rows gate)
+        and not (cfg.key_bn_running_stats and not cfg.v3)
     ):
         # must fail loudly: per-group BN with UNPERMUTED keys is the exact
         # intra-batch statistics leak Shuffle-BN exists to prevent — worse
@@ -634,9 +638,15 @@ def make_train_step(
             # the key's running statistics trail the query's on the
             # params' momentum schedule (EMAN); stats_q is already
             # pmean'd, so the EMA stays replicated in lockstep
-            stats_k = ema_update(
-                state.batch_stats_k, stats_q, ema_momentum(state.step)
-            )
+            m_stats = ema_momentum(state.step)
+            if cfg.key_bn_stats_warmup:
+                # fast-track early statistics (tf.train.EMA num_updates
+                # schedule): at m=0.999 a cold-start EMA would normalize
+                # keys with badly stale statistics for hundreds of steps
+                # — the r4 accuracy arm's suspected failure mechanism
+                step_f = state.step.astype(jnp.float32)
+                m_stats = jnp.minimum(m_stats, (1.0 + step_f) / (10.0 + step_f))
+            stats_k = ema_update(state.batch_stats_k, stats_q, m_stats)
         else:
             stats_k = lax.pmean(stats_k, DATA_AXIS)
 

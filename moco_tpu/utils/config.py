@@ -75,6 +75,13 @@ class MocoConfig:
     # Requires shuffle='none' (or 'syncbn' for the query side); the
     # v2-step lever only (the v3 step has its own momentum encoder).
     key_bn_running_stats: bool = False
+    # Fast-tracking warmup for the key-stats EMA (EMAN lever only):
+    # stats momentum min(m_params(step), (1+step)/(10+step)) — the
+    # classic num_updates moving-average schedule. Addresses the r4
+    # accuracy-arm mechanism (at m=0.99 over 160 steps the key BN
+    # normalized with ~60-step-stale statistics); at ImageNet scale the
+    # schedule converges to the params momentum within one epoch.
+    key_bn_stats_warmup: bool = True
     cifar_stem: bool = False
     compute_dtype: str = "bfloat16"
     # MoCo v3 (queue-free symmetric contrastive): set num_negatives=0,

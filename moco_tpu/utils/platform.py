@@ -27,6 +27,44 @@ def pin_platform_from_env() -> None:
         jax.config.update("jax_platforms", want)
 
 
+def enable_persistent_compilation_cache(path: str | None = None) -> None:
+    """Persistent XLA compilation cache shared by every process on this
+    host: a program compiled once (the ~3.5-min r50/224 TPU step; the
+    pathologically slow bn_stats_rows variant, PROFILE.md) is a disk hit
+    for every later bench leg / chain / driver run instead of a repeat
+    compile. Opt-out with MOCO_NO_COMPILE_CACHE=1; failures degrade to
+    the uncached behavior silently (older jax may lack the knobs).
+    """
+    if os.environ.get("MOCO_NO_COMPILE_CACHE") == "1":
+        return
+    path = path or os.environ.get("MOCO_COMPILE_CACHE_DIR", "/tmp/moco_jax_cache")
+    try:
+        import jax
+
+        if (
+            jax.default_backend() == "cpu"
+            and not os.environ.get("MOCO_COMPILE_CACHE_DIR")
+        ):
+            # CPU runs (the test suite, ablation chains, accelerator-less
+            # hosts): compile time is not the bottleneck there, and
+            # XLA:CPU's AOT cache loader warns (and threatens SIGILL) on
+            # machine-feature mismatches between writer and reader
+            # processes on this host. Keyed on the RESOLVED backend —
+            # jax.default_backend() initializes it, which every caller
+            # was about to do anyway; callers that must not touch a
+            # possibly-wedged tunnel (bench.py) gate this call behind
+            # their own backend_usable() probe. An explicit
+            # MOCO_COMPILE_CACHE_DIR overrides.
+            return
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # cache everything non-trivial; the default 1s floor would skip
+        # nothing we care about, but be explicit for clarity
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
+
 def backend_usable(timeout: int = 180) -> bool:
     """Probe the default accelerator backend in a SUBPROCESS with a
     timeout; True when `jax.devices()` succeeds there.

@@ -46,13 +46,14 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from moco_tpu.utils.platform import pin_platform_from_env
+from moco_tpu.utils.platform import enable_persistent_compilation_cache, pin_platform_from_env
 
 pin_platform_from_env()
+enable_persistent_compilation_cache()
 
 ABLATION_DIR = "artifacts/ablation"
 
-ARMS = ("none", "gather_perm", "a2a", "syncbn", "m0", "eman")
+ARMS = ("none", "gather_perm", "a2a", "syncbn", "m0", "eman", "eman_warmup")
 
 
 def run_arm(arm: str, args) -> dict:
@@ -73,7 +74,11 @@ def run_arm(arm: str, args) -> dict:
     # 'm0' isolates the EMA encoder on the reference shuffle; 'eman'
     # replaces Shuffle-BN entirely with the running-stats key forward
     # (key_bn_running_stats) — its accuracy arm at this budget
-    shuffle = "gather_perm" if arm == "m0" else "none" if arm == "eman" else arm
+    # 'eman_warmup' adds the round-5 key-stats fast-tracking schedule
+    # (key_bn_stats_warmup); 'eman' PINS it off so re-runs stay
+    # artifact-comparable with the r4 no-warmup seeds.
+    eman = arm in ("eman", "eman_warmup")
+    shuffle = "gather_perm" if arm == "m0" else "none" if eman else arm
     momentum = 0.0 if arm == "m0" else args.momentum
     # --virtual-groups G emulates the G-device per-device-BN topology
     # inside however many real devices exist (oracle-tested equivalent,
@@ -110,7 +115,8 @@ def run_arm(arm: str, args) -> dict:
             # per-group statistics with unpermuted keys, opted into
             # explicitly and only here (this is the positive control)
             allow_leaky_bn=(arm == "none" and vg > 1),
-            key_bn_running_stats=(arm == "eman"),
+            key_bn_running_stats=eman,
+            key_bn_stats_warmup=(arm == "eman_warmup"),
         ),
         optim=OptimConfig(lr=args.lr, epochs=args.epochs, cos=True, warmup_epochs=1),
         data=DataConfig(
@@ -244,6 +250,7 @@ def render_section(ablation_dir: str = ABLATION_DIR) -> str | None:
             "syncbn": "cross-replica BN",
             "m0": "Shuffle-BN, no EMA",
             "eman": "EMAN key (running-stats BN, no shuffle)",
+            "eman_warmup": "EMAN key + stats-EMA warmup schedule",
         }[arm]
         knn = r["final_knn_top1"]
         rows = r.get("bn_group_rows")

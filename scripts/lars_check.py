@@ -31,9 +31,10 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from moco_tpu.utils.platform import pin_platform_from_env
+from moco_tpu.utils.platform import enable_persistent_compilation_cache, pin_platform_from_env
 
 pin_platform_from_env()
+enable_persistent_compilation_cache()
 
 OUT_DIR = "artifacts/lars"
 
@@ -101,12 +102,18 @@ def run_arm(optimizer: str, args) -> dict:
     # wall-clock per step: the JSONL 'time' column is an absolute
     # timestamp per logged step (log_every=1 here), so per-step wall
     # time is the DIFF of consecutive stamps; drop the first epoch
-    # (compile + warmup) before taking the median
-    stamps = [
-        r["time"] for r in rows
-        if "time" in r and r.get("step", 0) > args.examples // args.batch
+    # (compile + warmup) before taking the median. kNN-eval rows share
+    # the stream — only diff stamps of ADJACENT-in-stream step rows
+    # (ones carrying 'loss'), so no diff absorbs an eval's wall time.
+    stamped = [
+        (i, r["time"]) for i, r in enumerate(rows)
+        if "time" in r and "loss" in r
+        and r.get("step", 0) > args.examples // args.batch
     ]
-    times = [b - a for a, b in zip(stamps, stamps[1:])]
+    times = [
+        tb - ta for (ia, ta), (ib, tb) in zip(stamped, stamped[1:])
+        if ib == ia + 1
+    ]
     return {
         "optimizer": optimizer,
         "lr": lr,

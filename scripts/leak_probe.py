@@ -39,9 +39,10 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from moco_tpu.utils.platform import pin_platform_from_env
+from moco_tpu.utils.platform import enable_persistent_compilation_cache, pin_platform_from_env
 
 pin_platform_from_env()
+enable_persistent_compilation_cache()
 
 OUT_PATH = "artifacts/leak_probe.json"  # NOT in the per-arm dir: render_section globs *.json there
 

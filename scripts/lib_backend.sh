@@ -1,0 +1,22 @@
+# Shared TPU-battery helper: block until the accelerator backend is
+# usable. Sourced by scripts/tpu_battery_r4b.sh and
+# scripts/tpu_chains_r4.sh (callers set $L to their log dir first).
+#
+# The probe (moco_tpu.utils.platform.backend_usable) runs jax.devices()
+# in a SUBPROCESS with a timeout and ABANDONS it on expiry — never
+# kills it: SIGKILLing a TPU client mid-init wedges the chip lease for
+# 1h+ (the round-4 battery incident). Waiting here instead of burning
+# leg timeouts against a wedged lease is what lets a battery survive
+# tunnel outages.
+wait_backend() {
+  until python - <<'EOF'
+import sys
+sys.path.insert(0, ".")
+from moco_tpu.utils.platform import backend_usable
+sys.exit(0 if backend_usable(timeout=150) else 1)
+EOF
+  do
+    echo "backend not usable; waiting 180s ($(date +%H:%M:%S))" | tee -a "$L/battery_wait.log"
+    sleep 180
+  done
+}

@@ -1,0 +1,23 @@
+#!/bin/bash
+# EMAN accuracy arm, RE-RUN with the key-stats EMA warmup schedule
+# (MocoConfig.key_bn_stats_warmup, round-5): 3 seeds at the EXACT
+# seed-variance budget so the result pools against the r4 table
+# (REPORT.md "EMAN key forward": 35.55 ± 4.49 vs gather_perm's
+# 53.65 ± 0.59 without the warmup). If the staleness mechanism the r4
+# analysis proposed is right, fast-tracked early statistics should
+# close most of the deficit; if not, the preset gets demoted.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p artifacts/eman_warmup
+for seed in 0 1 2; do
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python scripts/ablate_shuffle.py \
+    --arms eman_warmup \
+    --epochs 10 --examples 1024 --batch 64 --queue 2048 \
+    --seed "$seed" \
+    --workdir "/tmp/moco_eman_warmup_seed$seed" \
+    --out "artifacts/eman_warmup/seed$seed" \
+    --report "/tmp/eman_warmup_scratch.md" --marker "eman-warmup-scratch" \
+    >> artifacts/eman_warmup/run.log 2>&1
+done
+echo done > artifacts/eman_warmup/finished

@@ -22,19 +22,24 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-ARMS = ("gather_perm", "a2a", "syncbn", "eman")
+ARMS = ("gather_perm", "a2a", "syncbn", "eman", "eman_warmup")
 
 
 def collect(base_dir: str = "artifacts") -> dict[str, list[dict]]:
     """arm -> list of per-seed result dicts, seed-sorted."""
     dirs = [os.path.join(base_dir, "ablation")]
-    seeds_root = os.path.join(base_dir, "ablation_seeds")
-    if os.path.isdir(seeds_root):
-        dirs += sorted(
-            os.path.join(seeds_root, d)
-            for d in os.listdir(seeds_root)
-            if d.startswith("seed")
-        )
+    for seeds_root in (
+        os.path.join(base_dir, "ablation_seeds"),
+        # round-5: the eman_warmup arm's seeds (scripts/run_eman_warmup.sh)
+        # live in their own root so the r4 no-warmup artifacts stay intact
+        os.path.join(base_dir, "eman_warmup"),
+    ):
+        if os.path.isdir(seeds_root):
+            dirs += sorted(
+                os.path.join(seeds_root, d)
+                for d in os.listdir(seeds_root)
+                if d.startswith("seed")
+            )
     out: dict[str, list[dict]] = {a: [] for a in ARMS}
     for d in dirs:
         for arm in ARMS:
@@ -62,7 +67,18 @@ def render_section(results: dict[str, list[dict]]) -> str | None:
         r["epochs"], r["examples"], r["global_batch"], r["queue"]
     )
     counts = Counter(budget_of(r) for rs in present.values() for r in rs)
-    majority = counts.most_common(1)[0][0]
+    ranked = counts.most_common()
+    if len(ranked) > 1 and ranked[0][1] == ranked[1][1]:
+        # A 50/50 split must not silently crown whichever budget was
+        # inserted first (Counter.most_common tie = insertion order) —
+        # the stale half could win. Fail loudly with both listed.
+        tied = sorted(b for b, c in ranked if c == ranked[0][1])
+        raise SystemExit(
+            "seed_variance_report: tied majority budgets "
+            f"{tied} ({ranked[0][1]} runs each) — re-run the stray "
+            "arms at one budget or delete the stale artifact dirs"
+        )
+    majority = ranked[0][0]
     excluded = []
     for arm in list(present):
         keep = [r for r in present[arm] if budget_of(r) == majority]

@@ -21,18 +21,7 @@ L=artifacts/tpu_r4
 mkdir -p "$L"
 date > "$L/battery_b_started"
 
-wait_backend() {
-  until python - <<'EOF'
-import sys
-sys.path.insert(0, ".")
-from moco_tpu.utils.platform import backend_usable
-sys.exit(0 if backend_usable(timeout=150) else 1)
-EOF
-  do
-    echo "backend not usable; waiting 180s ($(date +%H:%M:%S))" | tee -a "$L/battery.log"
-    sleep 180
-  done
-}
+source "$(dirname "$0")/lib_backend.sh"  # wait_backend
 
 run() { # name timeout_s env... -- cmd...
   local name=$1 t=$2; shift 2
@@ -44,6 +33,12 @@ run() { # name timeout_s env... -- cmd...
   env "${envs[@]}" timeout -k 60 "$t" "$@" > "$L/$name.out" 2> "$L/$name.log"
   echo "rc=$? $name" | tee -a "$L/battery.log"
 }
+
+# r50 headline + fused-vs-dense NUMERICS cross-check (VERDICT r4 #3):
+# one compiled step per path from identical state/batch, loss/acc
+# compared to tolerance in the leg output — on-chip correctness
+# evidence for the default-on Pallas InfoNCE, independent of pytest.
+run bench_r50_numerics 2700 BENCH_SKIP_DATA=1 BENCH_NUMERICS=1 -- python bench.py
 
 # ViT v3 step bench, flash off/on (battery item 4)
 run bench_vit 2700 BENCH_ARCH=vit_b16 BENCH_SKIP_DATA=1 -- python bench.py
@@ -62,6 +57,19 @@ run input_transfer 1800 -- python scripts/profile_input.py --batch 64 --n-images
 # statistics pass — one third of the 55%-of-step BN-bytes cost center
 # (PROFILE.md). Expected to COMPILE FINE (it removes reduces).
 run bench_r50_eman 2700 BENCH_SKIP_DATA=1 BENCH_KEY_BN_EVAL=1 -- python bench.py
+
+# bn_stats_rows compile-pathology bisect (VERDICT r4 #2): small ConvBN
+# stacks, rows x variant grid, per-cell subprocess compiles timed.
+# Runs BEFORE the full-step bn32 bench legs so the diagnosis lands even
+# if those wedge; abandons (never kills) a timed-out compiling cell.
+# 14400s > worst case (15 cells x 900s = 13500s): the OUTER timeout
+# must never fire mid-grid — a TERM/KILL there orphans a compiling
+# child against the single-client chip (the r4 wedge); the harness
+# bounds itself per-cell and stops on the first abandoned cell.
+run bn_compile_repro 14400 -- python scripts/bn_compile_repro.py \
+  --depths 1 4 8 --rows 0 32 --variants mask fwd barrier slice \
+  --cell-timeout 900 --abandon-on-timeout \
+  --out artifacts/tpu_r4/bn_compile_repro.json
 
 # BN-bytes lever A/Bs — the slow-compile suspects, LAST, 45 min each
 run bench_r50_bn32 2700 BENCH_SKIP_DATA=1 BENCH_BN_STATS_ROWS=32 -- python bench.py

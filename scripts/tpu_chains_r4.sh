@@ -12,8 +12,11 @@ L=artifacts/tpu_chains_r4
 mkdir -p "$L"
 date > "$L/chains_started"
 
+source "$(dirname "$0")/lib_backend.sh"  # wait_backend (shared guard)
+
 run() { # name timeout_s -- cmd...
   local name=$1 t=$2; shift 2; shift # consume "--"
+  wait_backend
   echo "=== $name ($(date +%H:%M:%S)) ===" | tee -a "$L/chains.log"
   timeout -k 60 "$t" "$@" > "$L/$name.out" 2> "$L/$name.log"
   echo "rc=$? $name" | tee -a "$L/chains.log"

@@ -204,16 +204,25 @@ class TestKeyBnRunningStats:
     encoder runs eval-mode BN, its running statistics EMA-track the
     query's, and the incompatible-config gates fail loudly."""
 
-    def test_step_runs_and_stats_track_query(self):
-        config = tiny_config(shuffle="none", key_bn_running_stats=True, momentum=0.9)
+    @pytest.mark.parametrize("warmup", [True, False])
+    def test_step_runs_and_stats_track_query(self, warmup):
+        config = tiny_config(
+            shuffle="none",
+            key_bn_running_stats=True,
+            key_bn_stats_warmup=warmup,
+            momentum=0.9,
+        )
         _, _, _, state, step = setup(config)
         k_stats0 = jax.tree.map(np.array, state.batch_stats_k)
         state, metrics = step(state, make_batch(), jax.random.key(1))
         assert np.isfinite(float(metrics["loss"]))
         # batch_stats_k must be EXACTLY the EMA of its old value toward
-        # the new (pmean'd) query statistics — the lockstep invariant
+        # the new (pmean'd) query statistics — the lockstep invariant.
+        # With the warmup schedule, step 0's momentum fast-tracks to
+        # min(0.9, (1+0)/(10+0)) = 0.1 (the num_updates schedule).
+        m = min(0.9, 0.1) if warmup else 0.9
         expected = jax.tree.map(
-            lambda old, q: 0.9 * old + 0.1 * np.asarray(q),
+            lambda old, q: m * old + (1 - m) * np.asarray(q),
             k_stats0,
             jax.tree.map(np.array, state.batch_stats_q),
         )

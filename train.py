@@ -64,6 +64,13 @@ def build_parser() -> argparse.ArgumentParser:
         "collectives (requires --shuffle none or syncbn; see "
         "imagenet_v2_eman preset)",
     )
+    p.add_argument(
+        "--no-key-bn-stats-warmup", dest="key_bn_stats_warmup",
+        action="store_false", default=None,
+        help="disable the key-stats EMA fast-tracking warmup schedule "
+        "(on by default with --key-bn-eval) — reproduces the r4 "
+        "no-warmup EMAN arm exactly",
+    )
     # ViT options (moco-v3 family)
     p.add_argument(
         "--v3", action="store_true", default=None,
@@ -159,6 +166,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         bn_stats_rows=args.bn_stats_rows,
         bn_virtual_groups=args.bn_virtual_groups,
         key_bn_running_stats=args.key_bn_running_stats,
+        key_bn_stats_warmup=args.key_bn_stats_warmup,
         v3=args.v3,
         momentum_cos=args.moco_m_cos,
         vit_pool=args.vit_pool,
@@ -208,9 +216,10 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
 
 def main() -> None:
     args = build_parser().parse_args()
-    from moco_tpu.utils.platform import pin_platform_from_env
+    from moco_tpu.utils.platform import enable_persistent_compilation_cache, pin_platform_from_env
 
     pin_platform_from_env()
+    enable_persistent_compilation_cache()
     config = config_from_args(args)
     from moco_tpu.train import train
 
