@@ -61,6 +61,19 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 CHILD_ENV_FLAG = "BN_REPRO_CHILD"
 
 
+def depth_cells(rows, variants):
+    """Cell order within a depth: the rows=0 baseline FIRST (its timing
+    anchors the bisect), control variants next, the shipped slice-subset
+    suspects LAST — so an abandoned pathological cell forfeits the least
+    information."""
+    sub_rows = [r for r in rows if r]
+    cells = [("slice", 0)] if 0 in rows and "slice" in variants else []
+    cells += [(v, r) for v in variants if v != "slice" for r in sub_rows]
+    if "slice" in variants:
+        cells += [("slice", r) for r in sub_rows]
+    return cells
+
+
 def child_main() -> None:
     """Time lower+compile of one grid cell; print one JSON line."""
     from moco_tpu.utils.platform import pin_platform_from_env
@@ -226,25 +239,14 @@ def main() -> None:
     ap.add_argument("--out", default="artifacts/bn_compile_repro.json")
     args = ap.parse_args()
 
-    def depth_cells(depth):
-        """Cell order within a depth: the rows=0 baseline FIRST (its
-        timing anchors the bisect), control variants next, the shipped
-        slice-subset suspects LAST — so an abandoned pathological cell
-        forfeits the least information."""
-        sub_rows = [r for r in args.rows if r]
-        cells = [("slice", 0)] if 0 in args.rows and "slice" in args.variants else []
-        cells += [(v, r) for v in args.variants if v != "slice" for r in sub_rows]
-        if "slice" in args.variants:
-            cells += [("slice", r) for r in sub_rows]
-        return cells
-
     results = []
     stop = False
+    cells = depth_cells(args.rows, args.variants)
     print(f"{'depth':>5} {'rows':>5} {'variant':>8} {'lower_s':>8} {'compile_s':>10}")
     for depth in args.depths:
         if stop:
             break
-        for variant, rows in depth_cells(depth):
+        for variant, rows in cells:
             if stop:
                 break
             spec = dict(

@@ -1,0 +1,70 @@
+"""Guards on moco_tpu.utils.platform.enable_persistent_compilation_cache.
+
+The persistent XLA compilation cache exists so TPU battery legs and the
+driver's end-of-round bench share one compile of the ~3.5-min r50/224
+step (PROFILE.md). It must stay OFF for CPU-resolved runs: XLA:CPU's
+AOT cache loader warns (and documents a SIGILL hazard) on
+machine-feature mismatches between writer and reader processes.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import pytest
+
+from moco_tpu.utils.platform import enable_persistent_compilation_cache
+
+
+@pytest.fixture
+def restore_cache_config():
+    before = jax.config.jax_compilation_cache_dir
+    yield
+    jax.config.update("jax_compilation_cache_dir", before)
+
+
+def test_cpu_backend_skips_cache(restore_cache_config, monkeypatch, tmp_path):
+    # conftest pins the CPU platform, so default_backend() == "cpu" here
+    monkeypatch.delenv("MOCO_COMPILE_CACHE_DIR", raising=False)
+    monkeypatch.delenv("MOCO_NO_COMPILE_CACHE", raising=False)
+    jax.config.update("jax_compilation_cache_dir", None)
+    enable_persistent_compilation_cache(str(tmp_path / "cache"))
+    assert jax.config.jax_compilation_cache_dir is None
+    assert not (tmp_path / "cache").exists()
+
+
+def test_explicit_dir_overrides_cpu_guard(restore_cache_config, monkeypatch, tmp_path):
+    target = tmp_path / "explicit"
+    monkeypatch.setenv("MOCO_COMPILE_CACHE_DIR", str(target))
+    monkeypatch.delenv("MOCO_NO_COMPILE_CACHE", raising=False)
+    enable_persistent_compilation_cache()
+    assert jax.config.jax_compilation_cache_dir == str(target)
+    assert target.is_dir()
+
+
+def test_opt_out_wins(restore_cache_config, monkeypatch, tmp_path):
+    monkeypatch.setenv("MOCO_NO_COMPILE_CACHE", "1")
+    monkeypatch.setenv("MOCO_COMPILE_CACHE_DIR", str(tmp_path / "never"))
+    jax.config.update("jax_compilation_cache_dir", None)
+    enable_persistent_compilation_cache()
+    assert jax.config.jax_compilation_cache_dir is None
+    assert not (tmp_path / "never").exists()
+
+
+def test_bn_compile_repro_grid_order():
+    """The bisect harness must order each depth's cells baseline-first,
+    shipped-slice-suspects last (an abandoned pathological cell forfeits
+    the least information — scripts/bn_compile_repro.py docstring)."""
+    from conftest import load_script
+
+    mod = load_script("bn_compile_repro.py")
+    cells = mod.depth_cells([0, 32, 8], ["mask", "fwd", "barrier", "slice"])
+    assert cells[0] == ("slice", 0)
+    assert cells[-2:] == [("slice", 32), ("slice", 8)]
+    # controls in between, one per (variant, subset-rows) pair
+    assert set(cells[1:-2]) == {
+        (v, r) for v in ("mask", "fwd", "barrier") for r in (32, 8)
+    }
+    # no slice: no baseline cell, nothing crashes
+    assert mod.depth_cells([0, 32], ["mask"]) == [("mask", 32)]
