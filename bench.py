@@ -189,6 +189,9 @@ def main() -> None:
             # PROFILE.md byte-reduction lever); BENCH_BN_VIRTUAL_GROUPS=8
             # the virtual Shuffle-BN mode — both without code changes
             bn_stats_rows=int(os.environ.get("BENCH_BN_STATS_ROWS", 0)),
+            # BENCH_BN_STATS_BARRIER=1 adds the fusion barrier around the
+            # subset slice (the bn_compile_repro candidate workaround)
+            bn_stats_barrier=os.environ.get("BENCH_BN_STATS_BARRIER") == "1",
             bn_virtual_groups=int(os.environ.get("BENCH_BN_VIRTUAL_GROUPS", 0)),
             # BENCH_FUSED=0/1 pins the streaming Pallas InfoNCE off/on
             # (unset = the config's auto default) for the fused-vs-dense A/B

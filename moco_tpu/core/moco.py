@@ -110,6 +110,11 @@ def create_backbone(cfg: MocoConfig, num_data: Optional[int] = None) -> nn.Modul
         groups = [list(range(i, i + g)) for i in range(0, num_data, g)]
     if cfg.bn_virtual_groups > 1 and cfg.shuffle == "syncbn":
         raise ValueError("bn_virtual_groups does not compose with syncbn")
+    if cfg.bn_stats_barrier and not cfg.bn_stats_rows:
+        # must fail loudly: without subset rows the custom BatchNorm is
+        # never even selected, and a compile-pathology A/B would silently
+        # measure baseline-vs-baseline while reporting the barrier leg
+        raise ValueError("bn_stats_barrier requires bn_stats_rows > 0")
     if (
         cfg.bn_stats_rows
         and (cfg.shuffle == "none" or cfg.v3)
@@ -162,6 +167,7 @@ def create_backbone(cfg: MocoConfig, num_data: Optional[int] = None) -> nn.Modul
         bn_cross_replica_axis=syncbn_axis,
         bn_axis_index_groups=groups,
         bn_stats_rows=cfg.bn_stats_rows,
+        bn_stats_barrier=cfg.bn_stats_barrier,
         bn_virtual_groups=cfg.bn_virtual_groups,
     )
 

@@ -64,6 +64,13 @@ class BatchNorm(nn.Module):
     """
 
     stats_rows: int = 0
+    # With stats_rows: wrap the sliced subset in lax.optimization_barrier
+    # so the slice is NOT fused into the surrounding conv/reduce clusters.
+    # Candidate workaround for the TPU-backend compile pathology on the
+    # r50/224 subset-stats program (PROFILE.md r4; scripts/
+    # bn_compile_repro.py bisects it) — numerically identical, costs one
+    # small (r rows) materialization per BN.
+    stats_barrier: bool = False
     virtual_groups: int = 0
     use_running_average: bool = False
     momentum: float = 0.9
@@ -126,7 +133,10 @@ class BatchNorm(nn.Module):
             rows = x.shape[0]
             if self.stats_rows and self.stats_rows < rows:
                 rows = self.stats_rows
-            sub = x[:rows].astype(jnp.float32)
+            sub = x[:rows]
+            if self.stats_barrier and rows < x.shape[0]:
+                sub = jax.lax.optimization_barrier(sub)
+            sub = sub.astype(jnp.float32)
             reduce_axes = tuple(range(sub.ndim - 1))
             mean = jnp.mean(sub, axis=reduce_axes)
             mean2 = jnp.mean(jnp.square(sub), axis=reduce_axes)
@@ -230,6 +240,8 @@ class ResNet(nn.Module):
     # Training BN statistics from the first N rows of the (per-device)
     # batch; 0 = full batch (exact nn.BatchNorm). See BatchNorm above.
     bn_stats_rows: int = 0
+    # Fusion barrier around the subset slice (see BatchNorm.stats_barrier).
+    bn_stats_barrier: bool = False
     # Per-group statistics over G contiguous row-groups (the reference's
     # per-GPU BN inside one device's batch). See BatchNorm above.
     bn_virtual_groups: int = 0
@@ -243,7 +255,11 @@ class ResNet(nn.Module):
         custom = self.bn_stats_rows or self.bn_virtual_groups > 1
         norm_cls = BatchNorm if custom else nn.BatchNorm
         extra = (
-            {"stats_rows": self.bn_stats_rows, "virtual_groups": self.bn_virtual_groups}
+            {
+                "stats_rows": self.bn_stats_rows,
+                "stats_barrier": self.bn_stats_barrier,
+                "virtual_groups": self.bn_virtual_groups,
+            }
             if custom
             else {}
         )

@@ -40,6 +40,11 @@ class MocoConfig:
     # build_encoder rejects it with shuffle='none' on a multi-device
     # data axis (fine single-device, where it is a pure perf lever).
     bn_stats_rows: int = 0
+    # With bn_stats_rows: fusion barrier around the subset slice
+    # (BatchNorm.stats_barrier) — numerically identical; candidate
+    # workaround for the r50/224 TPU compile pathology (PROFILE.md r4,
+    # scripts/bn_compile_repro.py).
+    bn_stats_barrier: bool = False
     # Virtual Shuffle-BN on few devices: per-group BN statistics over G
     # contiguous row-groups of each device's batch (the reference's
     # per-GPU BN semantics inside one chip), and the key batch is

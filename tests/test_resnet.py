@@ -132,6 +132,39 @@ class TestSubsetStatsBatchNorm:
         )
         np.testing.assert_allclose(np.asarray(y2[:8]), np.asarray(y[:8]), atol=1e-6)
 
+    def test_stats_barrier_numerically_identical(self):
+        """`stats_barrier` only breaks XLA fusion around the subset
+        slice (the bn_compile_repro candidate workaround); outputs,
+        running stats, and input gradients must match the plain slice
+        path to float tolerance."""
+        from moco_tpu.models.resnet import BatchNorm
+
+        x = jax.random.normal(jax.random.PRNGKey(0), (16, 3, 3, 5))
+        plain = BatchNorm(stats_rows=4, use_running_average=False)
+        barred = BatchNorm(stats_rows=4, stats_barrier=True, use_running_average=False)
+        v = plain.init(jax.random.PRNGKey(1), x)
+        yp, mp = plain.apply(v, x, mutable=["batch_stats"])
+        yb, mb = barred.apply(v, x, mutable=["batch_stats"])
+        np.testing.assert_allclose(np.asarray(yp), np.asarray(yb), atol=1e-6)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, atol=1e-6),
+            mp["batch_stats"], mb["batch_stats"],
+        )
+        gp = jax.grad(lambda x: plain.apply(v, x, mutable=["batch_stats"])[0].sum())(x)
+        gb = jax.grad(lambda x: barred.apply(v, x, mutable=["batch_stats"])[0].sum())(x)
+        np.testing.assert_allclose(np.asarray(gp), np.asarray(gb), atol=1e-5)
+
+    def test_stats_barrier_without_rows_rejected(self):
+        from moco_tpu.core import build_encoder
+        from moco_tpu.utils.config import MocoConfig
+
+        cfg = MocoConfig(
+            arch="resnet18", shuffle="none", cifar_stem=True,
+            bn_stats_barrier=True,
+        )
+        with pytest.raises(ValueError, match="bn_stats_barrier"):
+            build_encoder(cfg)
+
     def test_running_stats_update_and_eval_mode(self):
         from moco_tpu.models.resnet import BatchNorm
 

@@ -52,6 +52,12 @@ def build_parser() -> argparse.ArgumentParser:
         "32-row per-GPU statistics granularity",
     )
     p.add_argument(
+        "--bn-stats-barrier", action="store_true", default=None,
+        help="with --bn-stats-rows: fusion barrier around the subset "
+        "slice (candidate workaround for the TPU compile pathology, "
+        "see PROFILE.md / scripts/bn_compile_repro.py)",
+    )
+    p.add_argument(
         "--bn-virtual-groups", type=int, default=None,
         help="virtual Shuffle-BN: per-group BN statistics over G row-groups "
         "+ in-batch key permutation — the reference's G-GPU recipe on one chip",
@@ -164,6 +170,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         mlp=args.mlp,
         shuffle=args.shuffle,
         bn_stats_rows=args.bn_stats_rows,
+        bn_stats_barrier=args.bn_stats_barrier,
         bn_virtual_groups=args.bn_virtual_groups,
         key_bn_running_stats=args.key_bn_running_stats,
         key_bn_stats_warmup=args.key_bn_stats_warmup,
