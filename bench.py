@@ -236,6 +236,7 @@ def main() -> None:
     # would corrupt training silently while benching fast — this prints
     # on-chip correctness evidence without needing the pytest session.
     # Opt-in (two extra full-step compiles, ~2×3.5 min on the chip).
+    crosscheck_ok = True
     if (
         os.environ.get("BENCH_NUMERICS") == "1"
         and not is_vit
@@ -259,16 +260,18 @@ def main() -> None:
         # Both paths share the (bf16) encoder forwards bit-for-bit; they
         # differ only in the logits/log-sum-exp arithmetic (f32 in both),
         # so tolerance is tight relative to the ~ln(1+K)≈11 loss scale.
-        ok = d_loss <= 5e-2 and d_acc <= 1.0
+        crosscheck_ok = d_loss <= 5e-2 and d_acc <= 1.0
         print(
             "numerics crosscheck: "
             f"fused loss={outs['fused'][0]:.6f} acc1={outs['fused'][1]:.3f} "
             f"dense loss={outs['dense'][0]:.6f} acc1={outs['dense'][1]:.3f} "
-            f"dloss={d_loss:.2e} dacc1={d_acc:.3f} {'PASS' if ok else 'FAIL'}",
+            f"dloss={d_loss:.2e} dacc1={d_acc:.3f} "
+            f"{'PASS' if crosscheck_ok else 'FAIL'}",
             file=sys.stderr,
         )
-        if not ok:
-            raise SystemExit("fused-vs-dense numerics crosscheck FAILED")
+        # a FAIL must still let the bench finish (a chip window is
+        # precious; the headline JSON and the FAIL line are both
+        # evidence) — the nonzero exit happens after the JSON prints
 
     # Warmup (compile) + steady state. NB: sync via a host transfer, not
     # block_until_ready — on the experimental axon TPU platform
@@ -403,6 +406,8 @@ def main() -> None:
             }
         )
     )
+    if not crosscheck_ok:
+        raise SystemExit("fused-vs-dense numerics crosscheck FAILED")
 
 
 if __name__ == "__main__":

@@ -98,6 +98,11 @@ class BatchNorm(nn.Module):
             raise ValueError(f"virtual_groups must be >= 0, got {self.virtual_groups}")
         if self.stats_rows and self.virtual_groups > 1:
             raise ValueError("stats_rows and virtual_groups are mutually exclusive")
+        if self.stats_barrier and not self.stats_rows:
+            # inert-flag combo must fail loudly (like the gates above): a
+            # compile-pathology A/B with the barrier silently dropped
+            # would measure baseline-vs-baseline
+            raise ValueError("stats_barrier requires stats_rows > 0")
         if self.virtual_groups > 1 and self.axis_name is not None:
             raise ValueError("virtual_groups does not compose with cross-replica BN")
         if self.use_running_average:

@@ -164,6 +164,13 @@ class TestSubsetStatsBatchNorm:
         )
         with pytest.raises(ValueError, match="bn_stats_barrier"):
             build_encoder(cfg)
+        # the module-level gate catches direct construction too
+        from moco_tpu.models.resnet import BatchNorm
+
+        bn = BatchNorm(stats_barrier=True, use_running_average=False)
+        x = jnp.zeros((4, 2, 2, 3))
+        with pytest.raises(ValueError, match="stats_barrier"):
+            bn.init(jax.random.PRNGKey(0), x)
 
     def test_running_stats_update_and_eval_mode(self):
         from moco_tpu.models.resnet import BatchNorm
