@@ -67,8 +67,9 @@ def build_parser() -> argparse.ArgumentParser:
         default=None,
         help="EMAN-style key forward: eval-mode BN from EMA'd running "
         "statistics — drops the key-side BN stats pass and the Shuffle-BN "
-        "collectives (requires --shuffle none or syncbn; see "
-        "imagenet_v2_eman preset)",
+        "collectives (requires --shuffle none or syncbn). EXPERIMENTAL: "
+        "measured accuracy arms trail Shuffle-BN at every tested budget "
+        "(REPORT.md 'EMAN key forward')",
     )
     p.add_argument(
         "--no-key-bn-stats-warmup", dest="key_bn_stats_warmup",
