@@ -39,6 +39,7 @@ from moco_tpu.core.ema import ema_update
 from moco_tpu.core.queue import check_queue_divisibility, enqueue, init_queue
 from moco_tpu.models import ProjectionHead, V3MLPHead, create_resnet
 from moco_tpu.ops.losses import cross_entropy, infonce_logits, l2_normalize, topk_accuracy
+from moco_tpu.parallel.compat import shard_map
 from moco_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 from moco_tpu.parallel.shuffle import (
     balanced_shuffle,
@@ -710,7 +711,7 @@ def make_train_step(
         zero_opt_state=state_template.opt_state if zero else None,
     )
     batch_spec = {"im_q": P(DATA_AXIS), "im_k": P(DATA_AXIS)}
-    sharded = jax.shard_map(
+    sharded = shard_map(
         step_fn,
         mesh=mesh,
         in_specs=(specs, batch_spec, P()),

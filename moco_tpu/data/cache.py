@@ -48,6 +48,8 @@ from typing import Callable, Optional, Union
 
 import numpy as np
 
+from moco_tpu.utils import retry
+
 __all__ = ["PackedRGBCacheDataset", "build_rgb_cache"]
 
 
@@ -191,15 +193,22 @@ def _build(source, cache_dir, num_workers, canvas_size, root_real) -> None:
     samples = source.samples
     n = len(samples)
 
+    dead_slots = [0]  # undecodable sources, recorded in the stamp
+
     def decode(i):
         """Decode + canvas-resize in the worker (the consumer thread only
-        writes), returning ready-to-write bytes."""
+        writes), returning ready-to-write bytes. File reads retry;
+        genuinely undecodable sources become counted dead slots."""
         path, label = samples[i]
         try:
-            with Image.open(path) as im:
-                arr = np.asarray(im.convert("RGB"), np.uint8)
+            def _read():
+                with Image.open(path) as im:
+                    return np.asarray(im.convert("RGB"), np.uint8)
+
+            arr = retry.retry_call(_read, site="data.cache_build")
         except Exception:
-            arr = np.zeros((1, 1, 3), np.uint8)  # dead slot, mirrors loaders
+            dead_slots[0] += 1  # dead slot, mirrors loaders — but COUNTED
+            arr = np.zeros((1, 1, 3), np.uint8)
         return arr.tobytes(), arr.shape[:2], _canvas(arr, canvas_size).tobytes(), int(label)
 
     offsets = np.zeros(n + 1, np.int64)
@@ -243,6 +252,13 @@ def _build(source, cache_dir, num_workers, canvas_size, root_real) -> None:
     )
     os.replace(data_tmp, os.path.join(cache_dir, "data.bin"))
     os.replace(canvas_tmp, os.path.join(cache_dir, f"canvas_{canvas_size}.bin"))
+    if dead_slots[0]:
+        import warnings
+
+        warnings.warn(
+            f"RGB cache build: {dead_slots[0]}/{n} images failed to decode "
+            "(zero-filled dead slots, recorded in the stamp)"
+        )
     with open(os.path.join(cache_dir, ".complete"), "w") as f:
         json.dump(
             {
@@ -250,6 +266,7 @@ def _build(source, cache_dir, num_workers, canvas_size, root_real) -> None:
                 "canvas_sizes": [canvas_size],
                 "root": root_real,
                 "fingerprint": _fingerprint(samples),
+                "dead_slots": dead_slots[0],
             },
             f,
         )
@@ -293,15 +310,27 @@ class PackedRGBCacheDataset:
     ):
         if not os.path.exists(os.path.join(cache_dir, ".complete")):
             raise FileNotFoundError(f"no complete RGB cache under {cache_dir}")
-        idx = np.load(os.path.join(cache_dir, "index.npz"))
+        # transient-store retries on the open path; once the memmap is
+        # established, page reads are the kernel's problem
+        idx = retry.retry_call(
+            np.load, os.path.join(cache_dir, "index.npz"), site="data.cache_open"
+        )
         self.offsets = idx["offsets"]
         self._dims = idx["dims"]
         self.labels = idx["labels"]
         self.num_classes = int(idx["num_classes"])
         self.decode_size = decode_size
         self._num_workers = max(num_workers, 1)
-        self._data = np.memmap(
-            os.path.join(cache_dir, "data.bin"), dtype=np.uint8, mode="r"
+        # dead slots stamped at build time: a constant decode_failures
+        # count the pipeline surfaces like the live loaders' counters
+        stamp = _read_stamp(cache_dir) or {}
+        self.decode_failures = int(stamp.get("dead_slots", 0))
+        self._data = retry.retry_call(
+            np.memmap,
+            os.path.join(cache_dir, "data.bin"),
+            dtype=np.uint8,
+            mode="r",
+            site="data.cache_open",
         )
         self._native = None
         if use_native is not False:

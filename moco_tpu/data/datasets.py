@@ -24,6 +24,8 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from moco_tpu.utils import retry
+
 IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".webp")
 
 
@@ -488,8 +490,12 @@ class Cifar10Dataset:
                     f"{path} not found — provide the standard cifar-10-batches-py "
                     "directory (no network access to download it)"
                 )
-            with open(path, "rb") as f:
-                d = pickle.load(f, encoding="bytes")
+
+            def _read(p=path):
+                with open(p, "rb") as f:
+                    return pickle.load(f, encoding="bytes")
+
+            d = retry.retry_call(_read, site="data.cifar10")
             images.append(d[b"data"])
             labels.extend(d[b"labels"])
         data = np.concatenate(images).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
@@ -518,6 +524,10 @@ class ImageFolderDataset:
             raise ValueError(f"no class subdirectories under {root}")
         self.class_to_idx = {c: i for i, c in enumerate(classes)}
         self.num_classes = len(classes)
+        # Cumulative zero-filled crop slots (undecodable images) —
+        # surfaced by the pipeline as the `decode_failures` metric so
+        # corrupt data is visible instead of silently training on black.
+        self.decode_failures = 0
         self.samples: list[tuple[str, int]] = []
         for c in classes:
             cdir = os.path.join(root, c)
@@ -535,22 +545,27 @@ class ImageFolderDataset:
 
         path, label = self.samples[index]
         size = decode_size or self.decode_size
-        with Image.open(path) as im:
-            im = im.convert("RGB")
-            # Shortest-side resize to `size` on the host; used by the eval
-            # center-crop path and as the canvas for on-device RRC when
-            # host_rrc is off. (Training normally uses the host-crop
-            # protocol below, which samples crops against the ORIGINAL
-            # geometry — no canvas clipping.)
-            w, h = im.size
-            s = size / min(w, h)
-            # explicit BILINEAR: the reference's torchvision transforms
-            # default, and what native/loader.cc reproduces (antialiased)
-            im = im.resize(
-                (max(size, round(w * s)), max(size, round(h * s))),
-                resample=Image.BILINEAR,
-            )
-            arr = np.asarray(im, np.uint8)
+
+        def _decode():
+            with Image.open(path) as im:
+                im = im.convert("RGB")
+                # Shortest-side resize to `size` on the host; used by the
+                # eval center-crop path and as the canvas for on-device RRC
+                # when host_rrc is off. (Training normally uses the
+                # host-crop protocol below, which samples crops against the
+                # ORIGINAL geometry — no canvas clipping.)
+                w, h = im.size
+                s = size / min(w, h)
+                # explicit BILINEAR: the reference's torchvision transforms
+                # default, and what native/loader.cc reproduces (antialiased)
+                im = im.resize(
+                    (max(size, round(w * s)), max(size, round(h * s))),
+                    resample=Image.BILINEAR,
+                )
+                return np.asarray(im, np.uint8)
+
+        # transient filesystem errors retry; a truly bad file raises
+        arr = retry.retry_call(_decode, site="data.imagefolder")
         # Center-crop the long side to a square canvas of fixed shape so
         # batches stack.
         h, w, _ = arr.shape
@@ -616,7 +631,7 @@ class ImageFolderDataset:
                         )
                         out[row, c] = np.asarray(crop, np.uint8)
             except Exception:
-                pass  # slot stays zero, mirroring the native loader
+                self.decode_failures += 1  # slot stays zero, but COUNTED
 
         if pool is None:
             from concurrent.futures import ThreadPoolExecutor

@@ -28,6 +28,8 @@ from typing import Optional
 
 import numpy as np
 
+from moco_tpu.utils import retry
+
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(__file__))), "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libmoco_loader.so")
 _build_lock = threading.Lock()
@@ -152,23 +154,35 @@ class NativeBatchLoader:
         self.paths = paths
         self.canvas = canvas
         self.num_paths = len(paths)
+        # Hard (native + PIL both failed) decode failures, cumulative.
+        # Zero-filled slots are silent black images to the trainer —
+        # this counter is how the pipeline makes them visible
+        # (`decode_failures` in metrics.jsonl).
+        self.decode_failures = 0
 
     def _pil_fallback(self, path: str) -> Optional[np.ndarray]:
         """Decode one image through PIL with the same geometry (the
-        ImageFolderDataset.load recipe) for formats the C++ side lacks."""
+        ImageFolderDataset.load recipe) for formats the C++ side lacks.
+        The file read retries (transient NFS/GCS errors must not count
+        as a decode failure); a genuinely undecodable image returns
+        None."""
         try:
             from PIL import Image
 
             size = self.canvas
-            with Image.open(path) as im:
-                im = im.convert("RGB")
-                w, h = im.size
-                s = size / min(w, h)
-                im = im.resize(
-                    (max(size, round(w * s)), max(size, round(h * s))),
-                    resample=Image.BILINEAR,
-                )
-                arr = np.asarray(im, np.uint8)
+
+            def _decode():
+                with Image.open(path) as im:
+                    im = im.convert("RGB")
+                    w, h = im.size
+                    s = size / min(w, h)
+                    im = im.resize(
+                        (max(size, round(w * s)), max(size, round(h * s))),
+                        resample=Image.BILINEAR,
+                    )
+                    return np.asarray(im, np.uint8)
+
+            arr = retry.retry_call(_decode, site="data.native_pil")
             h, w, _ = arr.shape
             y0, x0 = (h - size) // 2, (w - size) // 2
             return arr[y0 : y0 + size, x0 : x0 + size]
@@ -200,6 +214,7 @@ class NativeBatchLoader:
             if hard_failures:
                 import warnings
 
+                self.decode_failures += hard_failures
                 warnings.warn(
                     f"native loader: {hard_failures}/{len(idx)} images failed to decode"
                 )
@@ -283,6 +298,7 @@ class NativeBatchLoader:
             if hard_failures:
                 import warnings
 
+                self.decode_failures += hard_failures
                 warnings.warn(
                     f"native loader: {hard_failures}/{bs} images failed to decode"
                 )
@@ -410,6 +426,12 @@ class NativeImageFolderDataset:
 
     def __len__(self) -> int:
         return len(self.samples)
+
+    @property
+    def decode_failures(self) -> int:
+        """Cumulative hard decode failures (native + PIL both failed);
+        surfaced by the pipeline as a `decode_failures` metric."""
+        return self._loader.decode_failures
 
     def load(self, index: int, decode_size: Optional[int] = None) -> tuple[np.ndarray, int]:
         if decode_size is not None and decode_size != self.decode_size:

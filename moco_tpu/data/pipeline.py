@@ -44,6 +44,7 @@ from moco_tpu.data.augment import (
 from moco_tpu.data.datasets import build_dataset
 from moco_tpu.parallel.dist import ProcessDataPartition
 from moco_tpu.parallel.mesh import DATA_AXIS
+from moco_tpu.utils import faults, retry
 from moco_tpu.utils.config import DataConfig
 
 
@@ -122,15 +123,32 @@ class _HostPipeline:
 
     def _host_batch(self, indices: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         """(images uint8 stack, labels int32) via the native C++ batch path
-        when the dataset provides it, else the Python thread pool."""
-        if hasattr(self.dataset, "load_batch"):  # native/loader.cc decode pool
-            imgs, labels = self.dataset.load_batch(indices)
-            return imgs, np.asarray(labels, np.int32)
-        loads = list(self._pool.map(self.dataset.load, indices))
-        return (
-            np.stack([img for img, _ in loads]),
-            np.asarray([l for _, l in loads], np.int32),
-        )
+        when the dataset provides it, else the Python thread pool.
+
+        The whole read runs under the retry layer (site `data.read`):
+        a transient filesystem error — or an injected `io@site=data.read`
+        fault — degrades to a logged retry instead of aborting the epoch
+        through the prefetch thread."""
+
+        def _load():
+            faults.maybe_io_error("data.read")
+            if hasattr(self.dataset, "load_batch"):  # native/loader.cc decode pool
+                imgs, labels = self.dataset.load_batch(indices)
+                return imgs, np.asarray(labels, np.int32)
+            loads = list(self._pool.map(self.dataset.load, indices))
+            return (
+                np.stack([img for img, _ in loads]),
+                np.asarray([l for _, l in loads], np.int32),
+            )
+
+        return retry.retry_call(_load, site="data.read")
+
+    @property
+    def decode_failures(self) -> int:
+        """Cumulative undecodable samples seen by the underlying dataset
+        (zero-filled slots) — the train driver writes this to
+        metrics.jsonl so data corruption is visible, not silent."""
+        return int(getattr(self.dataset, "decode_failures", 0))
 
     def _epoch_order(self, epoch: int) -> np.ndarray:
         """Seeded shuffle per (seed, epoch) — sampler.set_epoch equivalent."""
@@ -163,7 +181,12 @@ class _HostPipeline:
         seeding overhead — ~120 ms of serial host time per 256-image
         batch, scripts/profile_input.py.)"""
         local_idx = self._partition.local_indices(global_indices)
-        dims = self.dataset.dims(local_idx)
+
+        def _read_dims():
+            faults.maybe_io_error("data.read")
+            return self.dataset.dims(local_idx)
+
+        dims = retry.retry_call(_read_dims, site="data.read")
         from moco_tpu.data.datasets import draw_rrc_uniforms, rrc_boxes_from_uniforms
 
         rng = np.random.default_rng((self.seed, epoch, step))
@@ -174,8 +197,13 @@ class _HostPipeline:
         boxes = rrc_boxes_from_uniforms(
             u_local, np.repeat(dims, n_crops, axis=0), scale=scale
         ).reshape(len(local_idx), n_crops, 4)
-        raw, labels = self.dataset.load_crop_batch(
-            local_idx, boxes, out_size, pool=self._pool
+        raw, labels = retry.retry_call(
+            self.dataset.load_crop_batch,
+            local_idx,
+            boxes,
+            out_size,
+            pool=self._pool,
+            site="data.read",
         )
         # assemble per crop on the HOST side: slicing the crop axis of an
         # already-assembled global array would not be fully-addressable

@@ -36,7 +36,7 @@ from moco_tpu.core.moco import MocoState, build_encoder, create_state
 from moco_tpu.data.pipeline import EvalPipeline, LabeledPipeline
 from moco_tpu.models import LinearClassifier
 from moco_tpu.ops.losses import cross_entropy, topk_accuracy
-from moco_tpu.parallel import create_mesh
+from moco_tpu.parallel import create_mesh, shard_map
 from moco_tpu.parallel.mesh import DATA_AXIS
 from moco_tpu.utils.checkpoint import (
     CheckpointManager,
@@ -156,7 +156,7 @@ def make_probe_step(backbone, classifier, tx, mesh):
         return state.replace(step=state.step + 1, fc_params=fc_params, opt_state=opt_state), metrics
 
     specs = ProbeState(step=P(), fc_params=P(), backbone_params=P(), backbone_stats=P(), opt_state=P())
-    sharded = jax.shard_map(
+    sharded = shard_map(
         step_fn,
         mesh=mesh,
         in_specs=(specs, P(DATA_AXIS), P(DATA_AXIS)),
@@ -191,7 +191,7 @@ def make_eval_step(backbone, classifier, mesh):
         return lax.psum(sums, DATA_AXIS)
 
     specs = ProbeState(step=P(), fc_params=P(), backbone_params=P(), backbone_stats=P(), opt_state=P())
-    sharded = jax.shard_map(
+    sharded = shard_map(
         eval_fn,
         mesh=mesh,
         in_specs=(specs, P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),

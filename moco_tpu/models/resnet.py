@@ -140,7 +140,9 @@ class BatchNorm(nn.Module):
                 rows = self.stats_rows
             sub = x[:rows]
             if self.stats_barrier and rows < x.shape[0]:
-                sub = jax.lax.optimization_barrier(sub)
+                from moco_tpu.parallel.compat import optimization_barrier
+
+                sub = optimization_barrier(sub)
             sub = sub.astype(jnp.float32)
             reduce_axes = tuple(range(sub.ndim - 1))
             mean = jnp.mean(sub, axis=reduce_axes)

@@ -34,6 +34,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from moco_tpu.parallel.compat import axis_size
+
 
 def flash_attention_fn(query, key, value, **kwargs):
     """`nn.MultiHeadDotProductAttention`-compatible attention_fn backed
@@ -198,7 +200,7 @@ class VisionTransformer(nn.Module):
         if self.sequence_axis is not None:
             try:
                 sp_rank = lax.axis_index(self.sequence_axis)
-                sp_n = lax.axis_size(self.sequence_axis)
+                sp_n = axis_size(self.sequence_axis)
             except NameError:
                 sp_rank = None
         if sp_rank is not None:

@@ -1,3 +1,4 @@
+from moco_tpu.parallel.compat import shard_map
 from moco_tpu.parallel.dist import (
     ProcessDataPartition,
     device_row_ranges,
@@ -40,4 +41,5 @@ __all__ = [
     "shuffle_gather",
     "unshuffle_gather",
     "ring_attention",
+    "shard_map",
 ]

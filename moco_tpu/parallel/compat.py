@@ -1,0 +1,58 @@
+"""Version-compat shims for jax APIs that moved between releases.
+
+The codebase targets the modern `jax.shard_map` surface (top-level
+export, `check_vma=` kwarg). Older jax lines (< 0.6) ship the same
+transform as `jax.experimental.shard_map.shard_map` with the flag
+spelled `check_rep=`. Every call site routes through :func:`shard_map`
+here so ONE module gates the difference — on an old jax the alternative
+is an `AttributeError` at trace time in every shard_map consumer (the
+whole train step, the probe, the shuffle tests), which reads like a
+training bug rather than what it is: a missing-API environment.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+    """`jax.shard_map` where available, else the experimental spelling
+    with `check_vma` mapped onto its older `check_rep` name."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=check_vma
+    )
+
+
+@jax.custom_vjp
+def optimization_barrier(x):
+    """`lax.optimization_barrier` with a gradient on every jax: older
+    releases ship the primitive without a differentiation rule, so the
+    barrier (an identity for values) carries an identity VJP — the
+    backward pass sees the same gradients either way."""
+    return jax.lax.optimization_barrier(x)
+
+
+def _barrier_fwd(x):
+    return optimization_barrier(x), None
+
+
+def _barrier_bwd(_, g):
+    return (g,)
+
+
+optimization_barrier.defvjp(_barrier_fwd, _barrier_bwd)
+
+
+def axis_size(axis_name) -> int:
+    """`jax.lax.axis_size` where available; on older jax `psum(1, axis)`
+    — which under shard_map is a static Python int, so shape arithmetic
+    downstream (reshape by the axis size) keeps working."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from moco_tpu.parallel.compat import axis_size
 from moco_tpu.parallel.mesh import DATA_AXIS
 
 
@@ -49,7 +50,7 @@ def scatter_mean(x: jax.Array, axis_name: str = DATA_AXIS) -> jax.Array:
     """Mean-reduce a full local grad leaf across the axis AND keep only
     this replica's (m,) shard — one psum_scatter, the fused collective
     that makes sharded weight update cost no extra communication."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     m = padded_cols(x.size, n)
     flat = jnp.pad(x.reshape(-1), (0, n * m - x.size))
     return lax.psum_scatter(flat, axis_name, scatter_dimension=0, tiled=True) / n
@@ -57,7 +58,7 @@ def scatter_mean(x: jax.Array, axis_name: str = DATA_AXIS) -> jax.Array:
 
 def local_shard(x: jax.Array, axis_name: str = DATA_AXIS) -> jax.Array:
     """This replica's (m,) rows of a replicated full leaf."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     r = lax.axis_index(axis_name)
     m = padded_cols(x.size, n)
     flat = jnp.pad(x.reshape(-1), (0, n * m - x.size))

@@ -15,7 +15,10 @@ Library entry: `train(config) -> final metrics`. CLI: repo-root
 
 from __future__ import annotations
 
+import math
+import os
 import signal
+import threading
 import time
 from typing import Optional
 
@@ -26,10 +29,17 @@ import numpy as np
 from moco_tpu.core import build_encoder, build_predictor, create_state, make_train_step, place_state
 from moco_tpu.data.pipeline import TwoCropPipeline
 from moco_tpu.parallel import create_mesh, create_multislice_mesh, maybe_initialize_multihost
+from moco_tpu.utils import faults, retry
 from moco_tpu.utils.checkpoint import CheckpointManager
-from moco_tpu.utils.config import TrainConfig, config_to_dict
+from moco_tpu.utils.config import (
+    ResumeCompatError,
+    TrainConfig,
+    config_to_dict,
+    resume_compat_diff,
+)
 from moco_tpu.utils.metrics import AverageMeter, MetricWriter, ProgressMeter, profiler_trace
 from moco_tpu.utils.schedules import build_optimizer, make_lr_schedule
+from moco_tpu.utils.watchdog import StepWatchdog
 
 
 def train(
@@ -45,6 +55,10 @@ def train(
     (bank_dataset, test_dataset) pair for the periodic kNN monitor
     (config.knn_every_epochs); when None it is built from config.data.
     """
+    # Deterministic fault injection (chaos harness): MOCO_FAULTS installs
+    # a fresh plan per run; unset leaves any programmatic plan (tests)
+    # alone. Zero-cost when no plan is installed.
+    faults.install_from_env()
     # Multi-host rendezvous before any backend use (the reference's
     # dist.init_process_group; auto-detected from the coordinator env,
     # or forced with MOCO_MULTIHOST=1).
@@ -87,7 +101,21 @@ def train(
     )
     start_epoch = 0
     if ckpt.latest_step() is not None:  # --resume semantics, automatic
-        state, extra = ckpt.restore(state)
+
+        def _check_compat(extra: dict) -> None:
+            # fail fast with a readable diff BEFORE the state restore: a
+            # shape-mismatched restore would otherwise read as corruption
+            # (and quarantine a perfectly good checkpoint)
+            diffs = resume_compat_diff(extra, config, num_data)
+            if diffs:
+                raise ResumeCompatError(
+                    f"checkpoint under {config.workdir} is incompatible with the "
+                    "live config:\n  " + "\n  ".join(diffs)
+                )
+
+        # a corrupt newest checkpoint is quarantined and the next-older
+        # step restores instead (fault-tolerance layer)
+        state, extra = ckpt.restore(state, validate_extra=_check_compat)
         start_epoch = int(extra.get("epoch", 0)) + 1
         print(f"resumed from epoch {start_epoch - 1} (step {int(state.step)})")
 
@@ -194,6 +222,66 @@ def train(
 
     writer = MetricWriter(config.workdir)
     last_avg: dict = {}
+
+    # -- runtime guards (fault-tolerance layer) --------------------------
+    # `good_state` is the last state whose loss was observed finite (one
+    # extra on-device state reference; refreshed on log steps only). The
+    # NaN guard rolls back to it, and the watchdog's emergency save uses
+    # it — a wedged device can't be asked for the in-flight state.
+    guard = {"nan_steps": 0, "good_state": state, "epoch": start_epoch}
+    wd: Optional[StepWatchdog] = None
+    if config.watchdog_timeout > 0:
+
+        def _emergency():
+            # best-effort, bounded: the main thread is stuck in a device
+            # call, and the save itself may hang on a wedged runtime — run
+            # it in a sidecar thread and exit regardless after the budget.
+            try:
+                writer.write(
+                    0, {"event": "stall", "epoch": guard["epoch"],
+                        "watchdog_timeout": config.watchdog_timeout},
+                )
+                writer.fsync()
+            except Exception:
+                pass
+
+            def _save():
+                try:
+                    s = guard["good_state"]
+                    if int(s.step) in ckpt.all_steps():
+                        print(
+                            f"watchdog: step {int(s.step)} already durable, "
+                            "skipping emergency save", flush=True,
+                        )
+                        return
+                    ckpt.save(
+                        int(s.step), s,
+                        extra={
+                            # mid-epoch semantics, like the preemption path:
+                            # the current epoch is NOT complete, resume
+                            # redoes it from the start
+                            "epoch": guard["epoch"] - 1,
+                            "config": config_to_dict(config),
+                            "num_data": num_data,
+                            "emergency": True,
+                        },
+                        force=True,
+                    )
+                    ckpt.wait()
+                    print("watchdog: emergency checkpoint saved", flush=True)
+                except Exception as e:
+                    print(f"watchdog: emergency checkpoint failed: {e!r}", flush=True)
+
+            t = threading.Thread(target=_save, daemon=True)
+            t.start()
+            t.join(timeout=max(30.0, config.watchdog_timeout))
+
+        wd = StepWatchdog(
+            config.watchdog_timeout,
+            on_stall=_emergency,
+            dump_path=os.path.join(config.workdir, "stall_stacks.txt"),
+        ).start()
+
     try:
         with profiler_trace(profile_dir):
             for epoch in range(start_epoch, config.optim.epochs):
@@ -207,6 +295,7 @@ def train(
                     [batch_time, data_time, losses, top1, top5],
                     prefix=f"Epoch: [{epoch}]",
                 )
+                guard["epoch"] = epoch
                 end = time.perf_counter()
                 stop_now = False
                 for i, batch in enumerate(pipeline.epoch(epoch)):
@@ -214,26 +303,75 @@ def train(
                         break
                     data_time.update(time.perf_counter() - end)
                     state, metrics = step_fn(state, batch, root_rng)
+                    if wd is not None:
+                        wd.beat()  # a timestamp assignment — no device sync
                     if preempted["count"]:
                         stop_now = True
                         break
                     if i % config.log_every == 0 or i == steps_per_epoch - 1:
-                        # host sync only on log steps — keeps the device queue full
+                        # host sync only on log steps — keeps the device
+                        # queue full; ALL runtime guards piggyback on this
+                        # fetch (zero extra sync in the step loop)
                         m = {k: float(v) for k, v in metrics.items()}
-                        bs = config.data.global_batch
-                        losses.update(m["loss"], bs)
-                        top1.update(m["acc1"], bs)
-                        top5.update(m["acc5"], bs)
-                        batch_time.update(time.perf_counter() - end)
-                        progress.display(i)
-                        writer.write(
-                            int(state.step),
-                            {
+                        gstep = int(state.step)
+                        if faults.enabled():  # chaos harness hooks
+                            m["loss"] = faults.corrupt_loss(m["loss"], gstep)
+                            faults.maybe_stall(gstep)
+                            faults.maybe_preempt(gstep)
+                        if not math.isfinite(m["loss"]):
+                            # non-finite-loss guard: skip the poisoned
+                            # update (params/opt/queue roll back to the
+                            # last finite log step; the step counter keeps
+                            # advancing so checkpoint ids stay monotonic
+                            # and fault-free/faulted runs agree on step
+                            # counts), count it, abort past the threshold.
+                            guard["nan_steps"] += 1
+                            writer.write(
+                                gstep,
+                                {"epoch": epoch, "event": "nonfinite_loss",
+                                 "nan_steps": guard["nan_steps"]},
+                            )
+                            writer.fsync()
+                            print(
+                                f"WARNING: non-finite loss at step {gstep} "
+                                f"({guard['nan_steps']}/{config.nan_guard_threshold})"
+                                " — update skipped",
+                                flush=True,
+                            )
+                            if guard["nan_steps"] >= config.nan_guard_threshold:
+                                raise FloatingPointError(
+                                    f"aborting: {guard['nan_steps']} non-finite "
+                                    f"loss steps (threshold "
+                                    f"{config.nan_guard_threshold}); last at step "
+                                    f"{gstep}, epoch {epoch}, lr "
+                                    f"{float(lr_schedule(gstep - 1)):.3e} — see "
+                                    f"{writer.path}"
+                                )
+                            state = guard["good_state"].replace(step=state.step)
+                        else:
+                            guard["good_state"] = state
+                            bs = config.data.global_batch
+                            losses.update(m["loss"], bs)
+                            top1.update(m["acc1"], bs)
+                            top5.update(m["acc5"], bs)
+                            batch_time.update(time.perf_counter() - end)
+                            progress.display(i)
+                            payload = {
                                 "epoch": epoch,
-                                "lr": float(lr_schedule(int(state.step) - 1)),
+                                "lr": float(lr_schedule(gstep - 1)),
                                 **m,
-                            },
-                        )
+                            }
+                            # fault-tolerance observability: only present
+                            # when nonzero, so clean runs keep clean lines
+                            if guard["nan_steps"]:
+                                payload["nan_steps"] = guard["nan_steps"]
+                            decode_failures = getattr(pipeline, "decode_failures", 0)
+                            if decode_failures:
+                                payload["decode_failures"] = decode_failures
+                            io_retries = retry.snapshot()
+                            if io_retries:
+                                payload["io_retries"] = io_retries
+                            writer.write(gstep, payload)
                     end = time.perf_counter()
                 last_avg = {
                     "epoch": epoch,
@@ -275,12 +413,15 @@ def train(
                     )
                 if stop_now:
                     ckpt.wait()  # the preemption save must be durable before exit
+                    writer.fsync()  # ...and so must the metrics tail
                     print(
                         f"preempted mid-epoch {epoch}: state saved at step "
                         f"{int(state.step)}; resume will redo epoch {epoch}"
                     )
                     break
     finally:
+        if wd is not None:
+            wd.stop()
         writer.close()
         ckpt.close()
         for sig, h in prev_handlers.items():

@@ -4,13 +4,25 @@ from moco_tpu.utils.config import (
     OptimConfig,
     ParallelConfig,
     PRESETS,
+    ResumeCompatError,
     TrainConfig,
+    resume_compat_diff,
 )
 from moco_tpu.utils.schedules import build_optimizer, make_lr_schedule
-from moco_tpu.utils.checkpoint import CheckpointManager, restore_best, save_best
+from moco_tpu.utils.checkpoint import (
+    CheckpointCorruptionError,
+    CheckpointManager,
+    restore_best,
+    save_best,
+)
 from moco_tpu.utils.metrics import AverageMeter, MetricWriter, ProgressMeter, profiler_trace
+from moco_tpu.utils.watchdog import StepWatchdog
 
 __all__ = [
+    "CheckpointCorruptionError",
+    "ResumeCompatError",
+    "StepWatchdog",
+    "resume_compat_diff",
     "AverageMeter",
     "CheckpointManager",
     "MetricWriter",

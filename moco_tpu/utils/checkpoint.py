@@ -13,16 +13,35 @@ and epoch counter is one Orbax StandardSave — multi-host-safe (Orbax
 coordinates per-host shard writes; the reference needed the rank-0-only
 dance), atomic (tmp dir + rename), with keep-last-N garbage collection
 and an optional `best` alias for probe drivers.
+
+Fault tolerance (the robustness layer): a partial or corrupt newest
+checkpoint — torn write, truncated blob, unparseable metadata — is
+QUARANTINED (moved to `<dir>/quarantine/<step>`) and restore falls back
+to the next-older step instead of killing the resume, so a crash during
+a write costs at most one checkpoint interval. Save/restore I/O runs
+through `moco_tpu.utils.retry` (transient-store errors degrade to a
+logged retry), and the driver passes a `validate_extra` hook so a
+config-incompatible checkpoint fails fast with a readable diff *before*
+a shape-mismatched restore could masquerade as corruption.
 """
 
 from __future__ import annotations
 
 import os
-from typing import Any, Optional
+import shutil
+from typing import Any, Callable, Optional
 
 import jax
 import numpy as np
 import orbax.checkpoint as ocp
+
+from moco_tpu.utils import faults, retry
+
+
+class CheckpointCorruptionError(RuntimeError):
+    """Every checkpoint under the directory failed to restore (all
+    quarantined) — unlike a merely-missing directory this is never
+    silently treated as a fresh start."""
 
 
 class CheckpointManager:
@@ -64,57 +83,186 @@ class CheckpointManager:
         save-interval policy (used for the final epoch, which an interval
         of N would otherwise silently skip)."""
         extra = _jsonify(extra or {})
-        self._mgr.save(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardSave(state), extra=ocp.args.JsonSave(extra)
-            ),
-            force=force,
-        )
-        if not self.async_save:
-            self._mgr.wait_until_finished()
+
+        def _save():
+            self._mgr.save(
+                step,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardSave(state), extra=ocp.args.JsonSave(extra)
+                ),
+                force=force,
+            )
+            if not self.async_save:
+                self._mgr.wait_until_finished()
+
+        retry.retry_call(_save, site="ckpt.save")
+        if faults.enabled():  # chaos harness: corrupt this write on request
+            faults.on_checkpoint_saved(
+                self.directory, step, wait=self._mgr.wait_until_finished
+            )
 
     def wait(self) -> None:
         """Block until any in-flight async save is durable."""
         self._mgr.wait_until_finished()
 
+    def all_steps(self) -> list[int]:
+        """Committed step ids, unvalidated (ascending)."""
+        self._mgr.wait_until_finished()
+        return sorted(self._mgr.all_steps())
+
     def latest_step(self) -> Optional[int]:
+        """Newest step that passes cheap structural validation. A step
+        whose directory is visibly partial (missing commit metadata,
+        zero-length payload file, unreadable extras) is quarantined and
+        the next-older step answers — `restore` then deep-validates by
+        actually restoring."""
         self._mgr.wait_until_finished()  # async saves land before counting
-        return self._mgr.latest_step()
+        for step in sorted(self._mgr.all_steps(), reverse=True):
+            reason = self._structural_defect(step)
+            if reason is None:
+                return step
+            self._quarantine(step, reason)
+        return None
+
+    def _structural_defect(self, step: int) -> Optional[str]:
+        path = os.path.join(self.directory, str(step))
+        if not os.path.isdir(path):
+            return "step directory missing"
+        if not os.path.exists(os.path.join(path, "_CHECKPOINT_METADATA")):
+            return "no commit metadata (partial write)"
+        for root, _, names in os.walk(path):
+            for name in names:
+                fp = os.path.join(root, name)
+                try:
+                    if os.path.getsize(fp) == 0:
+                        return f"zero-length file {os.path.relpath(fp, path)} (torn write)"
+                except OSError as e:
+                    return f"unreadable file {os.path.relpath(fp, path)}: {e!r}"
+        try:
+            self._read_extra_step(step)
+        except Exception as e:
+            return f"extras unreadable: {e!r}"
+        return None
+
+    def _read_extra_step(self, step: int) -> dict:
+        restored = retry.retry_call(
+            self._mgr.restore,
+            step,
+            args=ocp.args.Composite(extra=ocp.args.JsonRestore()),
+            site="ckpt.restore",
+        )
+        return dict(restored["extra"] or {})
+
+    def _quarantine(self, step: int, reason) -> None:
+        """Move a bad step dir to `<dir>/quarantine/<step>` (kept for
+        post-mortem, out of Orbax's view) and refresh the manager."""
+        src = os.path.join(self.directory, str(step))
+        qdir = os.path.join(self.directory, "quarantine")
+        os.makedirs(qdir, exist_ok=True)
+        dst = os.path.join(qdir, str(step))
+        suffix = 0
+        while os.path.exists(dst):
+            suffix += 1
+            dst = os.path.join(qdir, f"{step}.{suffix}")
+        try:
+            os.rename(src, dst)
+        except OSError:
+            shutil.rmtree(src, ignore_errors=True)  # cross-device fallback
+        print(
+            f"WARNING: checkpoint step {step} quarantined to {dst}: {reason}",
+            flush=True,
+        )
+        self._mgr.reload()
 
     def read_extra(self, step: Optional[int] = None) -> dict:
         """Restore only the JSON extras (no state template needed) — lets
         tools discover the training config before building a restore
         template."""
         self._mgr.wait_until_finished()  # async saves land before reading
-        step = step if step is not None else self._mgr.latest_step()
+        step = step if step is not None else self.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {self.directory}")
-        restored = self._mgr.restore(step, args=ocp.args.Composite(extra=ocp.args.JsonRestore()))
-        return dict(restored["extra"] or {})
+        return self._read_extra_step(step)
 
-    def restore(self, abstract_state: Any, step: Optional[int] = None) -> tuple[Any, dict]:
+    def restore(
+        self,
+        abstract_state: Any,
+        step: Optional[int] = None,
+        validate_extra: Optional[Callable[[dict], None]] = None,
+    ) -> tuple[Any, dict]:
         """Restore into the structure/shardings of `abstract_state`.
 
         `abstract_state` may be a concrete pytree (freshly created state):
         its shape/dtype/sharding guide the restore, exactly the
         `load_state_dict` pattern of the reference's `--resume`.
+
+        With `step=None`, a corrupt newest checkpoint is quarantined and
+        the next-older one restores instead (fallback chain down to the
+        oldest); only when EVERY step fails does this raise
+        `CheckpointCorruptionError`. An explicit `step` restores exactly
+        that step or raises — no silent substitution.
+
+        `validate_extra(extra)` runs before the (expensive) state read;
+        it should raise on an incompatible checkpoint (config drift).
+        Its exception propagates untouched — incompatibility is a user
+        error affecting every step equally, NOT corruption, so nothing
+        is quarantined for it.
         """
         self._mgr.wait_until_finished()  # an in-flight async save must land first
-        step = step if step is not None else self._mgr.latest_step()
-        if step is None:
+        abstract = jax.tree.map(_abstract_leaf, abstract_state)
+        explicit = step is not None
+        candidates = [step] if explicit else sorted(self._mgr.all_steps(), reverse=True)
+        if not candidates:
             raise FileNotFoundError(f"no checkpoint under {self.directory}")
-        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, abstract_state)
-        restored = self._mgr.restore(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardRestore(abstract), extra=ocp.args.JsonRestore()
-            ),
+        failures: list[tuple[int, str]] = []
+        for s in candidates:
+            try:
+                extra = self._read_extra_step(s)
+            except Exception as e:
+                if explicit:
+                    raise
+                failures.append((s, repr(e)))
+                self._quarantine(s, e)
+                continue
+            if validate_extra is not None:
+                validate_extra(extra)  # incompatibility propagates, no quarantine
+            try:
+                restored = retry.retry_call(
+                    self._mgr.restore,
+                    s,
+                    args=ocp.args.Composite(state=ocp.args.StandardRestore(abstract)),
+                    site="ckpt.restore",
+                )
+            except Exception as e:
+                if explicit:
+                    raise
+                failures.append((s, repr(e)))
+                self._quarantine(s, e)
+                continue
+            if failures:
+                print(
+                    f"WARNING: restored fallback step {s} after quarantining "
+                    f"{[f[0] for f in failures]}",
+                    flush=True,
+                )
+            return restored["state"], extra
+        raise CheckpointCorruptionError(
+            f"all {len(failures)} checkpoint(s) under {self.directory} failed to "
+            f"restore and were quarantined: {failures} — inspect "
+            f"{os.path.join(self.directory, 'quarantine')}"
         )
-        return restored["state"], dict(restored["extra"] or {})
 
     def close(self) -> None:
         self._mgr.close()
+
+
+def _abstract_leaf(x):
+    """`ocp.utils.to_shape_dtype_struct` that tolerates templates already
+    containing `jax.ShapeDtypeStruct` leaves with `sharding=None` (orbax
+    0.7's converter assumes a sharding object and crashes on None)."""
+    if isinstance(x, jax.ShapeDtypeStruct):
+        return x
+    return ocp.utils.to_shape_dtype_struct(x)
 
 
 def _jsonify(extra: dict) -> dict:
@@ -152,7 +300,7 @@ def save_best(directory: str, state: Any, metric: float) -> None:
 
 def restore_best(directory: str, abstract_state: Any) -> tuple[Any, float]:
     path = os.path.join(os.path.abspath(directory), "best")
-    abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, abstract_state)
+    abstract = jax.tree.map(_abstract_leaf, abstract_state)
     with ocp.Checkpointer(ocp.CompositeCheckpointHandler()) as ckptr:
         out = ckptr.restore(
             path,

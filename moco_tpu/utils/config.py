@@ -213,6 +213,22 @@ class TrainConfig:
     knn_every_epochs: int = 0
     knn_k: int = 200
     knn_temperature: float = 0.07
+    # Non-finite-loss guard (fault-tolerance layer): checked on log steps
+    # only (piggybacks on the existing `i % log_every` device fetch — no
+    # extra host sync in the step loop). A NaN/Inf loss skips that step's
+    # update (params/opt/queue roll back to the last finite log step's
+    # state; the step counter keeps advancing so checkpoint ids stay
+    # monotonic) and is counted + written to metrics.jsonl; after
+    # `nan_guard_threshold` such events the run aborts with diagnostics
+    # instead of burning the fleet on a diverged model.
+    nan_guard_threshold: int = 10
+    # Stall watchdog: seconds without a completed step-loop iteration
+    # before the process dumps all-thread stacks, attempts an emergency
+    # checkpoint, and exits nonzero (a hung collective blocks the main
+    # thread in a device call forever — only a sidecar thread can see
+    # it). 0 disables. Must exceed the worst-case log interval; the
+    # first step additionally gets a compilation grace period.
+    watchdog_timeout: float = 0.0
 
 
 def config_to_dict(cfg: TrainConfig) -> dict:
@@ -250,10 +266,65 @@ def config_from_dict(d: dict) -> TrainConfig:
             for k in (
                 "seed", "workdir", "log_every", "checkpoint_every_epochs",
                 "checkpoint_async", "checkpoint_keep", "steps_per_epoch",
+                "nan_guard_threshold", "watchdog_timeout",
             )
             if k in d
         },
     )
+
+
+class ResumeCompatError(ValueError):
+    """The checkpoint being resumed was trained under a structurally
+    different config — restoring it into the live model would either
+    fail with an opaque shape error or, worse, silently succeed into the
+    wrong semantics. Carries a human-readable field-by-field diff."""
+
+
+# Structural fields a resume must agree on: they determine parameter /
+# optimizer-state / queue SHAPES (a mismatch makes the restore template
+# wrong). Tunables (lr, epochs, temperature, aug recipe, ...) may change
+# across a resume on purpose and are deliberately not listed.
+RESUME_COMPAT_FIELDS = {
+    "moco": (
+        "arch", "dim", "num_negatives", "mlp", "v3", "cifar_stem",
+        "vit_pool", "vit_patch_size", "vit_sequence_parallel",
+    ),
+    "data": ("image_size",),
+    "parallel": ("num_model", "shard_weight_update"),
+}
+
+
+def resume_compat_diff(saved_extra: dict, config: TrainConfig, num_data: int) -> list[str]:
+    """Field-by-field incompatibility diff between a checkpoint's saved
+    `extra` (as written by the train driver: `config` + `num_data`) and
+    the live run. Empty list = compatible. Unknown/missing saved keys are
+    skipped (older checkpoints stay resumable)."""
+    diffs = []
+    saved_cfg = saved_extra.get("config") or {}
+    live = config_to_dict(config)
+    for section, fields in RESUME_COMPAT_FIELDS.items():
+        saved_sec = saved_cfg.get(section) or {}
+        for f in fields:
+            if f not in saved_sec:
+                continue
+            sv, lv = saved_sec[f], live[section][f]
+            if isinstance(lv, tuple):
+                lv = list(lv)
+            if sv != lv:
+                diffs.append(f"{section}.{f}: checkpoint={sv!r} != config={lv!r}")
+    saved_nd = saved_extra.get("num_data")
+    if (
+        saved_nd is not None
+        and config.parallel.shard_weight_update
+        and int(saved_nd) != int(num_data)
+    ):
+        # ZeRO shards opt-state leaves (num_data, m): the mesh width is
+        # baked into the checkpoint's shapes
+        diffs.append(
+            f"num_data: checkpoint={saved_nd} != mesh={num_data} "
+            "(ZeRO opt state is sharded per data replica)"
+        )
+    return diffs
 
 
 def _v2(moco: MocoConfig, **kw) -> MocoConfig:

@@ -1,0 +1,211 @@
+"""Deterministic fault injection — the harness that makes the
+fault-tolerance layer *verifiable* rather than hopeful.
+
+A plan is installed from a spec string (usually the `MOCO_FAULTS` env
+var; `scripts/chaos_smoke.sh` and the tests drive it). Faults are keyed
+on deterministic counters — a global step number, the Nth read at a call
+site — never randomness, so a chaos run is exactly reproducible.
+
+Spec grammar: comma-separated faults, each `kind@key=val[:key=val...]`:
+
+    ckpt_truncate@step=N          truncate the checkpoint written at id N
+                                  (largest file under its state/ halved)
+                                  after the write completes — a partial/
+                                  torn write the restore path must survive
+    io@site=S:at=K[:times=M]      raise IOError on the Kth (1-based) read
+                                  at call site S (M consecutive reads;
+                                  default 1) — exercises the retry layer
+    nan@step=N[:times=M]          the loss observed at global steps
+                                  N..N+M-1 becomes NaN — exercises the
+                                  non-finite guard
+    stall@step=N:seconds=S        sleep S seconds at global step N (once)
+                                  — exercises the stall watchdog
+    preempt@step=N                SIGTERM this process at global step N
+                                  (once) — deterministic preemption
+
+Example:
+    MOCO_FAULTS="ckpt_truncate@step=8,io@site=data.read:at=3,nan@step=6"
+
+Zero-cost when disabled: all hooks early-return on a module-level None
+check, and the step-loop hooks are only ever called inside the existing
+`i % log_every` host-sync block (see ISSUE acceptance: no new host-side
+work in the step loop).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+from collections import Counter
+from typing import Optional
+
+KINDS = ("ckpt_truncate", "io", "nan", "stall", "preempt")
+
+_INT_KEYS = ("step", "at", "times")
+_FLOAT_KEYS = ("seconds",)
+_STR_KEYS = ("site",)
+
+
+class FaultPlan:
+    """Parsed spec + the deterministic trigger counters."""
+
+    def __init__(self, spec: str):
+        self.spec = spec
+        self.rules: list[tuple[str, dict]] = []
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            kind, _, params = part.partition("@")
+            if kind not in KINDS:
+                raise ValueError(
+                    f"unknown fault kind {kind!r} in {part!r} (known: {KINDS})"
+                )
+            kv: dict = {}
+            for tok in params.split(":"):
+                if not tok:
+                    continue
+                k, _, v = tok.partition("=")
+                if k in _INT_KEYS:
+                    kv[k] = int(v)
+                elif k in _FLOAT_KEYS:
+                    kv[k] = float(v)
+                elif k in _STR_KEYS:
+                    kv[k] = v
+                else:
+                    raise ValueError(f"unknown fault param {k!r} in {part!r}")
+            self.rules.append((kind, kv))
+        self._lock = threading.Lock()
+        self._io_counts: Counter = Counter()  # site -> reads seen
+        self._fired: set = set()  # once-only rule ids that already fired
+
+    def describe(self) -> list:
+        return [(k, dict(p)) for k, p in self.rules]
+
+    # -- hooks -----------------------------------------------------------
+    def maybe_io_error(self, site: str) -> None:
+        with self._lock:
+            self._io_counts[site] += 1
+            n = self._io_counts[site]
+        for kind, p in self.rules:
+            if kind != "io" or p.get("site", site) != site:
+                continue
+            at = p.get("at", 1)
+            if at <= n < at + p.get("times", 1):
+                raise IOError(f"injected fault: read #{n} at site {site!r}")
+
+    def corrupt_loss(self, loss: float, step: int) -> float:
+        for kind, p in self.rules:
+            if kind == "nan" and p["step"] <= step < p["step"] + p.get("times", 1):
+                return float("nan")
+        return loss
+
+    def maybe_stall(self, step: int) -> None:
+        for i, (kind, p) in enumerate(self.rules):
+            if kind == "stall" and p["step"] == step and self._fire_once(i):
+                print(f"injected fault: stalling {p['seconds']}s at step {step}", flush=True)
+                time.sleep(p["seconds"])
+
+    def maybe_preempt(self, step: int) -> None:
+        for i, (kind, p) in enumerate(self.rules):
+            if kind == "preempt" and p["step"] == step and self._fire_once(i):
+                print(f"injected fault: SIGTERM self at step {step}", flush=True)
+                os.kill(os.getpid(), signal.SIGTERM)
+
+    def on_checkpoint_saved(self, directory: str, step: int, wait=None) -> None:
+        for i, (kind, p) in enumerate(self.rules):
+            if kind == "ckpt_truncate" and p["step"] == step and self._fire_once(i):
+                if wait is not None:
+                    wait()  # async writes must land before we can corrupt them
+                _truncate_step_dir(directory, step)
+
+    def _fire_once(self, rule_id: int) -> bool:
+        with self._lock:
+            if rule_id in self._fired:
+                return False
+            self._fired.add(rule_id)
+            return True
+
+
+def _truncate_step_dir(directory: str, step: int) -> None:
+    """Halve the largest file under `<directory>/<step>/state` — the
+    shape of a torn write: the checkpoint directory looks committed, its
+    metadata parses, but the tensor payload is short."""
+    state_dir = os.path.join(directory, str(step), "state")
+    files = []
+    for root, _, names in os.walk(state_dir):
+        for name in names:
+            p = os.path.join(root, name)
+            if os.path.isfile(p):
+                files.append(p)
+    if not files:
+        raise RuntimeError(f"injected ckpt_truncate: no files under {state_dir}")
+    target = max(files, key=os.path.getsize)
+    size = os.path.getsize(target)
+    with open(target, "r+b") as f:
+        f.truncate(max(1, size // 2))
+    print(
+        f"injected fault: truncated {target} ({size} -> {max(1, size // 2)} bytes)",
+        flush=True,
+    )
+
+
+# -- module-level registry (one plan per process) ------------------------
+_PLAN: Optional[FaultPlan] = None
+
+
+def install(spec: Optional[str]) -> Optional[FaultPlan]:
+    """Install a fresh plan (counters reset); None/empty clears."""
+    global _PLAN
+    _PLAN = FaultPlan(spec) if spec else None
+    return _PLAN
+
+
+def install_from_env() -> Optional[FaultPlan]:
+    """Install from `MOCO_FAULTS` when set; otherwise leave the current
+    plan alone (tests install programmatically)."""
+    spec = os.environ.get("MOCO_FAULTS")
+    if spec:
+        return install(spec)
+    return _PLAN
+
+
+def clear() -> None:
+    install(None)
+
+
+def enabled() -> bool:
+    return _PLAN is not None
+
+
+def describe() -> list:
+    return _PLAN.describe() if _PLAN else []
+
+
+# thin delegating hooks — all no-ops when no plan is installed
+def maybe_io_error(site: str) -> None:
+    if _PLAN is not None:
+        _PLAN.maybe_io_error(site)
+
+
+def corrupt_loss(loss: float, step: int) -> float:
+    if _PLAN is not None:
+        return _PLAN.corrupt_loss(loss, step)
+    return loss
+
+
+def maybe_stall(step: int) -> None:
+    if _PLAN is not None:
+        _PLAN.maybe_stall(step)
+
+
+def maybe_preempt(step: int) -> None:
+    if _PLAN is not None:
+        _PLAN.maybe_preempt(step)
+
+
+def on_checkpoint_saved(directory: str, step: int, wait=None) -> None:
+    if _PLAN is not None:
+        _PLAN.on_checkpoint_saved(directory, step, wait=wait)

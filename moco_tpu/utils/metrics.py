@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import contextlib
 import json
+import math
 import os
 import time
 from typing import Optional
@@ -62,7 +63,14 @@ class ProgressMeter:
 
 
 class MetricWriter:
-    """Append-only JSONL metrics (one object per log event) + stdout."""
+    """Append-only JSONL metrics (one object per log event) + stdout.
+
+    Crash-safe tail (fault-tolerance layer): every line is flushed to
+    the OS as it is written, so a SIGKILL mid-epoch loses at most the
+    line being formatted — the retry/guard counters that land here are
+    precisely the events one needs to post-mortem a killed run. `fsync`
+    makes the tail durable across a host crash; the train driver calls
+    it at preemption/stall/abort, and `close` always does."""
 
     def __init__(self, workdir: str, filename: str = "metrics.jsonl"):
         os.makedirs(workdir, exist_ok=True)
@@ -77,10 +85,26 @@ class MetricWriter:
                 for k, v in payload.items()
             }
         )
-        self._f.write(json.dumps(rec) + "\n")
+        # NaN/Inf are not valid JSON (json.dumps would emit a literal a
+        # strict reader rejects); a non-finite metric becomes null — the
+        # guard writes its own explicit event for non-finite losses.
+        rec = {
+            k: (None if isinstance(v, float) and not math.isfinite(v) else v)
+            for k, v in rec.items()
+        }
+        self._f.write(json.dumps(rec, allow_nan=False) + "\n")
+        self._f.flush()
+
+    def fsync(self) -> None:
+        """Force the written tail to disk (preemption/abort paths)."""
+        if not self._f.closed:
+            self._f.flush()
+            os.fsync(self._f.fileno())
 
     def close(self) -> None:
-        self._f.close()
+        if not self._f.closed:
+            self.fsync()
+            self._f.close()
 
 
 @contextlib.contextmanager

@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""Chaos smoke: prove the fault-tolerance layer end-to-end on synthetic
+data (CPU, single device, a few minutes) — run by CI on every PR.
+
+Legs (each worker is a fresh subprocess, like a real crash/restart):
+
+  A. fault-free reference ........ 3 epochs, final checkpoint id = 3*spe
+  B1. ckpt_truncate .............. 2 epochs; the LAST epoch's checkpoint
+                                   write is truncated on disk (torn write)
+  B2. resume through corruption .. 3 epochs; resume must quarantine the
+      + transient loader IOError    corrupt step, fall back one interval,
+      + one NaN loss step          retry the injected read, skip the NaN
+                                   update — and still reach EXACTLY the
+                                   fault-free final step count
+  C1. stall + watchdog ........... a 120 s sleep is injected mid-epoch;
+                                   the 6 s watchdog must dump stacks,
+                                   write an emergency checkpoint, and
+                                   exit with the stall code (42)
+  C2. resume after stall ......... completes all epochs; the stall cost
+                                   at most one checkpoint interval extra
+
+Usage:
+    bash scripts/chaos_smoke.sh          # or: python scripts/chaos_smoke.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+# scripts/ is not a package; make the repo root importable for both the
+# orchestrator and the re-invoked workers
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+EPOCHS = 3
+SPE = 2  # 32 synthetic examples / global batch 16
+
+
+def worker(args: argparse.Namespace) -> None:
+    """One training process (the unit a preemption/crash kills)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    # collective-free RNG lowering (see tests/conftest.py); single-device
+    # CPU here, set for parity with the test harness
+    jax.config.update("jax_threefry_partitionable", True)
+
+    from moco_tpu.data.datasets import SyntheticDataset
+    from moco_tpu.train import train
+    from moco_tpu.utils.config import DataConfig, MocoConfig, OptimConfig, TrainConfig
+
+    config = TrainConfig(
+        moco=MocoConfig(
+            arch="resnet18", dim=16, num_negatives=32, mlp=True,
+            shuffle="none", cifar_stem=True, compute_dtype="float32",
+        ),
+        optim=OptimConfig(lr=0.03, epochs=args.epochs, cos=True),
+        data=DataConfig(
+            dataset="synthetic", image_size=16, global_batch=16, num_workers=2
+        ),
+        workdir=args.workdir,
+        log_every=1,
+        watchdog_timeout=args.watchdog_timeout,
+    )
+    dataset = SyntheticDataset(num_examples=32, image_size=16)
+    result = train(config, dataset=dataset)
+    print(f"WORKER_RESULT {json.dumps(result)}")
+
+
+def latest_step(workdir: str):
+    from moco_tpu.utils.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(workdir)
+    step = mgr.latest_step()
+    extra = mgr.read_extra() if step is not None else {}
+    mgr.close()
+    return step, extra
+
+
+def run_leg(name, workdir, epochs, faults=None, watchdog=0.0, expect_rc=0):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("MOCO_FAULTS", None)
+    if faults:
+        env["MOCO_FAULTS"] = faults
+    cmd = [
+        sys.executable, os.path.abspath(__file__), "--worker",
+        "--workdir", workdir, "--epochs", str(epochs),
+        "--watchdog-timeout", str(watchdog),
+    ]
+    print(f"\n=== {name}: epochs={epochs} faults={faults!r} watchdog={watchdog} ===")
+    proc = subprocess.run(cmd, env=env, timeout=900)
+    if proc.returncode != expect_rc:
+        raise SystemExit(
+            f"{name}: exit code {proc.returncode}, expected {expect_rc}"
+        )
+    print(f"=== {name}: exit {proc.returncode} (expected) ===")
+
+
+def check(cond, msg):
+    if not cond:
+        raise SystemExit(f"CHAOS SMOKE FAILED: {msg}")
+    print(f"ok: {msg}")
+
+
+def orchestrate(base: str) -> None:
+    a, b, c = (os.path.join(base, d) for d in ("fault_free", "chaos", "stall"))
+
+    # A. fault-free reference
+    run_leg("A fault-free", a, EPOCHS)
+    steps_a, extra_a = latest_step(a)
+    check(steps_a == EPOCHS * SPE, f"fault-free run reached step {steps_a} == {EPOCHS * SPE}")
+    check(extra_a["epoch"] == EPOCHS - 1, "fault-free run completed all epochs")
+
+    # B1: truncate the final (epoch-1) checkpoint write
+    run_leg("B1 ckpt_truncate", b, EPOCHS - 1, faults=f"ckpt_truncate@step={(EPOCHS - 1) * SPE}")
+    # B2: resume through the corruption, plus a transient loader error
+    # and one NaN step during the redone epochs
+    run_leg(
+        "B2 resume+io+nan", b, EPOCHS,
+        faults=f"io@site=data.read:at=2,nan@step={(EPOCHS - 1) * SPE + 1}",
+    )
+    steps_b, extra_b = latest_step(b)
+    check(
+        os.path.isdir(os.path.join(b, "quarantine")),
+        "corrupt checkpoint was quarantined, not fatal",
+    )
+    check(extra_b["epoch"] == EPOCHS - 1, "chaos run completed all epochs")
+    check(
+        steps_b == steps_a,
+        f"chaos final step {steps_b} == fault-free final step {steps_a}",
+    )
+    metrics = [json.loads(l) for l in open(os.path.join(b, "metrics.jsonl"))]
+    check(
+        any(m.get("event") == "nonfinite_loss" for m in metrics),
+        "NaN step was counted in metrics.jsonl",
+    )
+    check(
+        any(m.get("io_retries") for m in metrics),
+        "loader retry was surfaced in metrics.jsonl",
+    )
+
+    # C1: stall mid-epoch; the watchdog must kill the process nonzero
+    # after an emergency checkpoint (stall >> watchdog timeout)
+    # watchdog 20 s: far above a healthy CPU step (~seconds) so no false
+    # fire, far below the 120 s injected stall so the leg stays fast
+    run_leg(
+        "C1 stall+watchdog", c, EPOCHS,
+        faults="stall@step=3:seconds=120", watchdog=20.0, expect_rc=42,
+    )
+    steps_c1, _ = latest_step(c)
+    check(steps_c1 is not None, "watchdog wrote an emergency checkpoint")
+    check(
+        os.path.exists(os.path.join(c, "stall_stacks.txt")),
+        "watchdog dumped all-thread stacks",
+    )
+    # C2: resume to completion
+    run_leg("C2 resume after stall", c, EPOCHS)
+    steps_c2, extra_c = latest_step(c)
+    check(extra_c["epoch"] == EPOCHS - 1, "post-stall resume completed all epochs")
+    check(
+        0 <= steps_c2 - steps_a <= SPE,
+        f"stall cost {steps_c2 - steps_a} extra steps <= one interval ({SPE})",
+    )
+
+    print("\nCHAOS SMOKE PASSED")
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--worker", action="store_true")
+    p.add_argument("--workdir", default=None)
+    p.add_argument("--epochs", type=int, default=EPOCHS)
+    p.add_argument("--watchdog-timeout", type=float, default=0.0)
+    args = p.parse_args()
+    if args.worker:
+        worker(args)
+        return
+    base = args.workdir or tempfile.mkdtemp(prefix="chaos_smoke_")
+    print(f"chaos smoke workdir: {base}")
+    orchestrate(base)
+
+
+if __name__ == "__main__":
+    main()

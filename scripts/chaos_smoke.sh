@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Chaos smoke (fault-tolerance layer): tiny synthetic training runs with
+# injected checkpoint truncation, transient loader IOErrors, a NaN loss
+# step, and a watchdog-caught stall — asserting resume-through-corruption
+# reaches the fault-free final step count. See scripts/chaos_smoke.py for
+# the leg-by-leg breakdown. CPU-only, a few minutes; run by CI.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python scripts/chaos_smoke.py "$@"

@@ -1,5 +1,10 @@
 """Checkpoint round-trip: full MocoState (queue, EMA, opt_state) +
-resume semantics, the rebuild's answer to `--resume` (SURVEY.md §3.5)."""
+resume semantics, the rebuild's answer to `--resume` (SURVEY.md §3.5) —
+plus the fault-tolerance layer: corrupt-latest fallback, quarantine,
+and the fail-fast resume compatibility check."""
+
+import dataclasses
+import os
 
 import jax
 import jax.numpy as jnp
@@ -7,8 +12,22 @@ import numpy as np
 import pytest
 
 from moco_tpu.core import build_encoder, create_state
-from moco_tpu.utils.checkpoint import CheckpointManager, restore_best, save_best
-from moco_tpu.utils.config import DataConfig, MocoConfig, OptimConfig, TrainConfig
+from moco_tpu.utils import faults
+from moco_tpu.utils.checkpoint import (
+    CheckpointCorruptionError,
+    CheckpointManager,
+    restore_best,
+    save_best,
+)
+from moco_tpu.utils.config import (
+    DataConfig,
+    MocoConfig,
+    OptimConfig,
+    ResumeCompatError,
+    TrainConfig,
+    config_to_dict,
+    resume_compat_diff,
+)
 from moco_tpu.utils.schedules import build_optimizer
 
 
@@ -76,6 +95,149 @@ def test_best_snapshot(tmp_path, small_state):
     assert metric == 61.25
 
 
+def _truncate_state_file(directory, step):
+    """Simulate a torn write: halve the largest file under the step's
+    state/ payload (commit metadata stays — the dir looks complete)."""
+    state_dir = os.path.join(directory, str(step), "state")
+    files = [
+        os.path.join(root, f)
+        for root, _, names in os.walk(state_dir)
+        for f in names
+    ]
+    target = max(files, key=os.path.getsize)
+    with open(target, "r+b") as f:
+        f.truncate(os.path.getsize(target) // 2)
+
+
+def test_corrupt_latest_falls_back_and_quarantines(tmp_path, small_state):
+    """The tentpole behavior: a corrupt newest checkpoint costs one
+    checkpoint interval, not the run — it is quarantined and the
+    next-older step restores."""
+    d = str(tmp_path / "ckpt")
+    mgr = CheckpointManager(d, keep=3)
+    mgr.save(1, small_state, extra={"epoch": 0})
+    mgr.save(2, small_state, extra={"epoch": 1})
+    _truncate_state_file(d, 2)
+    restored, extra = mgr.restore(small_state)
+    assert extra["epoch"] == 0  # fell back to step 1
+    _assert_trees_equal(restored, small_state)
+    assert os.path.isdir(os.path.join(d, "quarantine", "2"))
+    assert not os.path.exists(os.path.join(d, "2"))
+    assert mgr.latest_step() == 1
+    # the manager still accepts new saves after a quarantine
+    mgr.save(3, small_state, extra={"epoch": 2})
+    _, extra = mgr.restore(small_state)
+    assert extra["epoch"] == 2
+    mgr.close()
+
+
+def test_all_corrupt_raises_corruption_error(tmp_path, small_state):
+    """Every checkpoint bad -> loud CheckpointCorruptionError, never a
+    silent fresh start."""
+    d = str(tmp_path / "ckpt")
+    mgr = CheckpointManager(d)
+    mgr.save(1, small_state, extra={"epoch": 0})
+    _truncate_state_file(d, 1)
+    with pytest.raises(CheckpointCorruptionError):
+        mgr.restore(small_state)
+    assert os.path.isdir(os.path.join(d, "quarantine", "1"))
+    mgr.close()
+
+
+def test_explicit_step_restore_does_not_fall_back(tmp_path, small_state):
+    d = str(tmp_path / "ckpt")
+    mgr = CheckpointManager(d)
+    mgr.save(1, small_state, extra={"epoch": 0})
+    mgr.save(2, small_state, extra={"epoch": 1})
+    _truncate_state_file(d, 2)
+    with pytest.raises(Exception) as e:
+        mgr.restore(small_state, step=2)
+    assert not isinstance(e.value, CheckpointCorruptionError)
+    assert os.path.exists(os.path.join(d, "2"))  # no quarantine either
+    mgr.close()
+
+
+def test_latest_step_skips_torn_write(tmp_path, small_state):
+    """Structural validation: a zero-length payload file (torn write)
+    disqualifies the step without a full restore."""
+    d = str(tmp_path / "ckpt")
+    mgr = CheckpointManager(d)
+    mgr.save(1, small_state, extra={"epoch": 0})
+    mgr.save(2, small_state, extra={"epoch": 1})
+    state_dir = os.path.join(d, "2", "state")
+    files = [
+        os.path.join(root, f)
+        for root, _, names in os.walk(state_dir)
+        for f in names
+    ]
+    with open(max(files, key=os.path.getsize), "r+b") as f:
+        f.truncate(0)
+    assert mgr.latest_step() == 1
+    assert os.path.isdir(os.path.join(d, "quarantine", "2"))
+    mgr.close()
+
+
+def test_validate_extra_incompat_fails_fast_without_quarantine(tmp_path, small_state):
+    """Config drift is a user error, not corruption: it must raise with
+    the diff BEFORE the state restore and must not quarantine anything."""
+    d = str(tmp_path / "ckpt")
+    mgr = CheckpointManager(d)
+    mgr.save(1, small_state, extra={"epoch": 0, "config": {"moco": {"dim": 16}}})
+
+    def reject(extra):
+        raise ResumeCompatError(f"incompatible: {extra['config']}")
+
+    with pytest.raises(ResumeCompatError):
+        mgr.restore(small_state, validate_extra=reject)
+    assert os.path.exists(os.path.join(d, "1"))
+    assert not os.path.isdir(os.path.join(d, "quarantine"))
+    mgr.close()
+
+
+def test_ckpt_truncate_fault_injection_roundtrip(tmp_path, small_state):
+    """The chaos harness's checkpoint fault composes with the fallback
+    restore: the faulted save is corrupted on disk, restore falls back."""
+    d = str(tmp_path / "ckpt")
+    mgr = CheckpointManager(d)
+    faults.install("ckpt_truncate@step=2")
+    try:
+        mgr.save(1, small_state, extra={"epoch": 0})
+        mgr.save(2, small_state, extra={"epoch": 1})  # truncated by the fault
+    finally:
+        faults.clear()
+    _, extra = mgr.restore(small_state)
+    assert extra["epoch"] == 0
+    assert os.path.isdir(os.path.join(d, "quarantine", "2"))
+    mgr.close()
+
+
+def test_resume_compat_diff_fields():
+    cfg = TrainConfig()
+    saved = {"config": config_to_dict(cfg), "num_data": 8}
+    assert resume_compat_diff(saved, cfg, 8) == []
+    # structural drift is caught, field by field
+    cfg2 = dataclasses.replace(
+        cfg, moco=dataclasses.replace(cfg.moco, arch="resnet50x", dim=256)
+    )
+    diffs = resume_compat_diff(saved, cfg2, 8)
+    assert any("moco.arch" in s for s in diffs)
+    assert any("moco.dim" in s for s in diffs)
+    # tunables may change freely across a resume
+    cfg3 = dataclasses.replace(
+        cfg, optim=dataclasses.replace(cfg.optim, lr=9.9, epochs=500)
+    )
+    assert resume_compat_diff(saved, cfg3, 8) == []
+    # ZeRO mesh-width mismatch only matters when sharding the update
+    zcfg = dataclasses.replace(
+        cfg, parallel=dataclasses.replace(cfg.parallel, shard_weight_update=True)
+    )
+    zsaved = {"config": config_to_dict(zcfg), "num_data": 8}
+    assert any("num_data" in s for s in resume_compat_diff(zsaved, zcfg, 4))
+    assert resume_compat_diff(saved, cfg, 4) == []  # non-ZeRO: free
+    # pre-layer checkpoints (no config recorded) stay resumable
+    assert resume_compat_diff({"epoch": 3}, cfg2, 8) == []
+
+
 def test_async_save_roundtrips_and_waits(tmp_path, small_state):
     """Async saves overlap with training; restore/wait must first land
     any in-flight write, and the round-trip is bit-identical."""
@@ -93,6 +255,7 @@ def test_async_save_roundtrips_and_waits(tmp_path, small_state):
     mgr.close()
 
 
+@pytest.mark.slow  # full train-driver cycle: minutes on a CPU host
 def test_async_driver_run_resumes(tmp_path):
     """The pretrain driver with checkpoint_async=True survives a full
     train() + auto-resume cycle."""

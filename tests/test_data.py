@@ -425,3 +425,37 @@ def test_crops_only_recipe_selection():
     assert r.mean == (0.4914, 0.4822, 0.4465)  # cifar stats at 32px
     # default path unchanged
     assert get_recipe(True, 32).jitter[0] == 0.4
+
+
+def test_imagefolder_counts_decode_failures(tmp_path):
+    """Undecodable images zero-fill their crop slots, but COUNT — the
+    pipeline surfaces the counter as the `decode_failures` metric so
+    corrupt data is visible instead of silently training on black."""
+    from PIL import Image
+
+    from moco_tpu.data.datasets import ImageFolderDataset
+
+    root = tmp_path / "imgs"
+    (root / "a").mkdir(parents=True)
+    rng = np.random.default_rng(0)
+    good = rng.integers(0, 256, (40, 40, 3), dtype=np.uint8)
+    Image.fromarray(good).save(root / "a" / "good.png")
+    (root / "a" / "corrupt.jpg").write_bytes(b"\xff\xd8\xff not a real jpeg")
+
+    ds = ImageFolderDataset(str(root), decode_size=16)
+    assert ds.decode_failures == 0
+    boxes = np.tile(np.array([[0, 0, 16, 16]], np.int64), (2, 1, 1))
+    out, labels = ds.load_crop_batch(np.array([0, 1]), boxes, 8)
+    # sorted listing: corrupt.jpg is index 0, good.png index 1
+    assert ds.decode_failures == 1
+    assert out[0].sum() == 0 and out[1].sum() > 0
+    # the pipeline property reads straight through to the dataset
+    from moco_tpu.utils.config import DataConfig
+
+    mesh = create_mesh(num_data=1, num_model=1)
+    pipe = TwoCropPipeline(
+        DataConfig(dataset="synthetic", image_size=16, global_batch=1, num_workers=1),
+        mesh,
+        dataset=ds,
+    )
+    assert pipe.decode_failures == 1

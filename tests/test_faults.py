@@ -1,0 +1,228 @@
+"""Fault-tolerance primitives: retrying I/O, the deterministic fault
+registry, and the stall watchdog (host-only — no jax programs here).
+
+The reference has no failure-handling story beyond "restart by hand with
+--resume" (SURVEY.md §5.3); these are the unit tests for the layer that
+replaces it."""
+
+import math
+import os
+import time
+
+import pytest
+
+from moco_tpu.utils import faults, retry
+from moco_tpu.utils.watchdog import StepWatchdog
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    faults.clear()
+    retry.snapshot(reset=True)
+    yield
+    faults.clear()
+    retry.snapshot(reset=True)
+
+
+# -- retry ---------------------------------------------------------------
+def test_retry_succeeds_after_transient_errors():
+    calls = {"n": 0}
+    sleeps = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise IOError("transient")
+        return "ok"
+
+    assert retry.retry_call(flaky, site="t.flaky", sleep=sleeps.append) == "ok"
+    assert calls["n"] == 3
+    assert len(sleeps) == 2 and all(s > 0 for s in sleeps)
+    assert retry.snapshot()["t.flaky"] == 2
+    assert "transient" in retry.last_errors()["t.flaky"]
+
+
+def test_retry_bounded_attempts_then_raises():
+    def always():
+        raise IOError("permanent")
+
+    with pytest.raises(IOError):
+        retry.retry_call(always, site="t.always", attempts=3, sleep=lambda s: None)
+    # 3 attempts = 2 retries counted; the final failure propagates
+    assert retry.snapshot()["t.always"] == 2
+
+
+def test_retry_does_not_catch_logic_errors():
+    def broken():
+        raise ValueError("a bug, not weather")
+
+    with pytest.raises(ValueError):
+        retry.retry_call(broken, site="t.logic", sleep=lambda s: None)
+    assert "t.logic" not in retry.snapshot()
+
+
+def test_retry_backoff_is_bounded():
+    sleeps = []
+
+    def always():
+        raise IOError("x")
+
+    with pytest.raises(IOError):
+        retry.retry_call(
+            always, site="t.bound", attempts=6,
+            base_delay=0.1, max_delay=0.4, sleep=sleeps.append,
+        )
+    # jitter is in [0.5, 1.5): every delay respects ceil * 1.5
+    assert all(s <= 0.4 * 1.5 for s in sleeps)
+    assert len(sleeps) == 5
+
+
+def test_snapshot_reset():
+    def once():
+        raise IOError("x")
+
+    with pytest.raises(IOError):
+        retry.retry_call(once, site="t.reset", attempts=2, sleep=lambda s: None)
+    assert retry.snapshot(reset=True) == {"t.reset": 1}
+    assert retry.snapshot() == {}
+
+
+# -- fault registry ------------------------------------------------------
+def test_spec_parsing_and_describe():
+    faults.install(
+        "ckpt_truncate@step=7,io@site=data.read:at=2:times=3,"
+        "nan@step=5,stall@step=3:seconds=0.01,preempt@step=9"
+    )
+    assert faults.enabled()
+    kinds = [k for k, _ in faults.describe()]
+    assert kinds == ["ckpt_truncate", "io", "nan", "stall", "preempt"]
+    faults.clear()
+    assert not faults.enabled() and faults.describe() == []
+
+
+def test_unknown_fault_kind_fails_fast():
+    with pytest.raises(ValueError):
+        faults.install("typo_kind@step=1")
+    with pytest.raises(ValueError):
+        faults.install("nan@stpe=1")
+
+
+def test_io_fault_fires_on_kth_read_at_site_only():
+    faults.install("io@site=s:at=2:times=2")
+    faults.maybe_io_error("s")  # read 1: fine
+    with pytest.raises(IOError):
+        faults.maybe_io_error("s")  # read 2: injected
+    with pytest.raises(IOError):
+        faults.maybe_io_error("s")  # read 3: injected (times=2)
+    faults.maybe_io_error("s")  # read 4: fine again
+    faults.maybe_io_error("elsewhere")  # other sites unaffected
+
+
+def test_io_fault_degrades_to_logged_retry():
+    """The composition the data pipeline relies on: an injected IOError
+    under the retry wrapper is one logged retry, not a failure."""
+    faults.install("io@site=d:at=1")
+
+    def read():
+        faults.maybe_io_error("d")
+        return 7
+
+    assert retry.retry_call(read, site="d", sleep=lambda s: None) == 7
+    assert retry.snapshot()["d"] == 1
+
+
+def test_nan_fault_window():
+    faults.install("nan@step=3:times=2")
+    assert faults.corrupt_loss(1.5, 2) == 1.5
+    assert math.isnan(faults.corrupt_loss(1.5, 3))
+    assert math.isnan(faults.corrupt_loss(1.5, 4))
+    assert faults.corrupt_loss(1.5, 5) == 1.5
+
+
+def test_stall_fires_once():
+    faults.install("stall@step=2:seconds=0.05")
+    t0 = time.monotonic()
+    faults.maybe_stall(1)
+    assert time.monotonic() - t0 < 0.04
+    t0 = time.monotonic()
+    faults.maybe_stall(2)
+    assert time.monotonic() - t0 >= 0.05
+    t0 = time.monotonic()
+    faults.maybe_stall(2)  # once-only
+    assert time.monotonic() - t0 < 0.04
+
+
+def test_hooks_are_noops_when_disabled():
+    faults.maybe_io_error("anywhere")
+    faults.maybe_stall(1)
+    faults.maybe_preempt(1)
+    assert faults.corrupt_loss(2.0, 1) == 2.0
+    faults.on_checkpoint_saved("/nonexistent", 1)
+
+
+# -- watchdog ------------------------------------------------------------
+def test_watchdog_fires_dumps_and_exits(tmp_path):
+    events = {}
+    dump = tmp_path / "stacks.txt"
+    wd = StepWatchdog(
+        timeout=0.2,
+        on_stall=lambda: events.setdefault("stall", True),
+        dump_path=str(dump),
+        startup_grace=0.2,  # tests beat immediately; no compile to cover
+        poll=0.05,
+        exit_fn=lambda code: events.setdefault("exit", code),
+    )
+    wd.start()
+    wd.beat()
+    time.sleep(0.6)  # no beats: must fire
+    wd.stop()
+    assert events.get("stall") is True
+    assert events.get("exit") == 42
+    assert "Thread" in dump.read_text()  # all-thread stack dump landed
+
+
+def test_watchdog_beats_prevent_firing():
+    fired = []
+    wd = StepWatchdog(
+        timeout=0.3, startup_grace=0.3, poll=0.05, exit_fn=fired.append
+    )
+    wd.start()
+    for _ in range(10):
+        time.sleep(0.05)
+        wd.beat()
+    wd.stop()
+    assert fired == []
+
+
+def test_watchdog_startup_grace_covers_compilation():
+    """Before the first beat the effective timeout is the startup grace
+    (first-step XLA compile can take minutes); after a beat, `timeout`
+    applies."""
+    fired = []
+    wd = StepWatchdog(
+        timeout=0.1, startup_grace=10.0, poll=0.02, exit_fn=fired.append
+    )
+    wd.start()
+    time.sleep(0.4)  # way past timeout, inside grace, zero beats
+    assert fired == []
+    wd.beat()
+    time.sleep(0.4)  # past timeout with beats seen: fires
+    wd.stop()
+    assert fired == [42]
+
+
+def test_watchdog_on_stall_exception_does_not_block_exit():
+    events = []
+
+    def bad_stall():
+        events.append("stall")
+        raise RuntimeError("emergency save failed")
+
+    wd = StepWatchdog(
+        timeout=0.1, startup_grace=0.1, poll=0.02,
+        on_stall=bad_stall, exit_fn=lambda c: events.append(c),
+    )
+    wd.start()
+    time.sleep(0.4)
+    wd.stop()
+    assert events == ["stall", 42]

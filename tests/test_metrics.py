@@ -32,3 +32,28 @@ def test_metric_writer_jsonl(tmp_path):
     lines = [json.loads(l) for l in open(w.path)]
     assert lines[0]["step"] == 5 and lines[0]["loss"] == 1.5
     assert lines[1]["step"] == 10
+
+
+def test_metric_writer_flushes_each_line(tmp_path):
+    """Crash-safety (fault-tolerance layer): a written line must be
+    visible in the file BEFORE close — a SIGKILL mid-epoch cannot lose
+    the metrics tail the retry/guard counters land in."""
+    w = MetricWriter(str(tmp_path))
+    w.write(1, {"loss": 2.0})
+    w.write(2, {"loss": 1.9, "io_retries": {"data.read": 3}})
+    lines = [json.loads(l) for l in open(w.path)]  # no close() yet
+    assert len(lines) == 2
+    assert lines[1]["io_retries"] == {"data.read": 3}
+    w.fsync()  # durable tail (preemption path); idempotent with close
+    w.close()
+    w.close()  # double-close must be safe (driver finally + tests)
+
+
+def test_metric_writer_sanitizes_non_finite(tmp_path):
+    """NaN/Inf are invalid JSON; they become null so the file stays
+    strict-JSONL-parseable (the guard writes its own explicit event)."""
+    w = MetricWriter(str(tmp_path))
+    w.write(1, {"loss": float("nan"), "acc1": float("inf"), "lr": 0.1})
+    w.close()
+    rec = json.loads(open(w.path).read())
+    assert rec["loss"] is None and rec["acc1"] is None and rec["lr"] == 0.1

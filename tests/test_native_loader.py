@@ -191,3 +191,24 @@ def test_imagefolder_crop_protocol_parity(image_dir):
     assert a.shape == b.shape == (len(py), 1, 16, 16, 3)
     diff = np.abs(a.astype(np.float32) - b.astype(np.float32)).mean()
     assert diff < 6.0
+
+
+def test_decode_failures_counter(tmp_path):
+    """Doubly-failed slots (native + PIL) zero-fill AND count — the
+    `decode_failures` surface the pipeline reports (fault-tolerance
+    layer); recoverable PIL-fallback slots do not count."""
+    root = tmp_path / "imgs"
+    (root / "a").mkdir(parents=True)
+    rng = np.random.default_rng(0)
+    arr = rng.integers(0, 256, (40, 40, 3), dtype=np.uint8)
+    Image.fromarray(arr).save(root / "a" / "good.jpg", quality=95)
+    (root / "a" / "corrupt.jpg").write_bytes(b"\xff\xd8\xff definitely not jpeg")
+
+    ds = NativeImageFolderDataset(str(root), decode_size=32, threads=2)
+    assert ds.decode_failures == 0
+    with pytest.warns(UserWarning, match="failed to decode"):
+        imgs, _ = ds.load_batch(np.arange(len(ds)))
+    assert ds.decode_failures == 1
+    # the good slot decoded, the corrupt one zero-filled
+    sums = imgs.reshape(len(ds), -1).sum(axis=1)
+    assert (sums == 0).sum() == 1 and (sums > 0).sum() == 1

@@ -3,6 +3,7 @@
 import dataclasses
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
@@ -36,6 +37,7 @@ def _one_step(remat: bool):
     return step(state, batch, rng)
 
 
+@pytest.mark.slow  # two full train-step compiles back to back
 def test_remat_is_numerically_identical():
     s1, m1 = _one_step(remat=False)
     s2, m2 = _one_step(remat=True)

@@ -319,6 +319,7 @@ class TestVirtualGroupBatchNorm:
             np.asarray(mut["batch_stats"]["mean"]), 0.5 * mean.mean(0), atol=1e-5
         )
 
+    @pytest.mark.slow  # compiles the real 8-device shuffle-BN oracle program
     def test_virtual_groups_equal_multi_device_shuffle_bn(self):
         """The oracle: ONE device with bn_virtual_groups=G must produce
         the same training program as G devices with per-device BN and

@@ -11,6 +11,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from moco_tpu.ops.flash_attention import _attn_reference
 from moco_tpu.parallel.ring_attention import ring_attention
+from moco_tpu.parallel.compat import shard_map
 
 B, H, D = 2, 2, 32
 SEQ_AXIS = "seq"
@@ -28,7 +29,7 @@ def test_matches_dense_full_sequence(n_dev, s_local):
     q, k, v = (jax.random.normal(kk, (B, H, s_total, D), jnp.float32) for kk in ks)
 
     ring = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda q, k, v: ring_attention(q, k, v, SEQ_AXIS, block_q=32, block_k=32, interpret=True),
             mesh=mesh,
             in_specs=(P(None, None, SEQ_AXIS), P(None, None, SEQ_AXIS), P(None, None, SEQ_AXIS)),
@@ -49,7 +50,7 @@ def test_differentiable_through_ring():
     q, k, v = (jax.random.normal(kk, (B, H, s_total, D), jnp.float32) for kk in ks)
 
     def ring_loss(q, k, v):
-        f = jax.shard_map(
+        f = shard_map(
             lambda q, k, v: ring_attention(q, k, v, SEQ_AXIS, block_q=32, block_k=32, interpret=True),
             mesh=mesh,
             in_specs=(P(None, None, SEQ_AXIS),) * 3,

@@ -17,6 +17,7 @@ from moco_tpu.parallel import (
     shuffle_gather,
     unshuffle_gather,
 )
+from moco_tpu.parallel.compat import shard_map
 
 
 def _mesh():
@@ -35,7 +36,7 @@ def test_shuffle_unshuffle_is_identity():
         return local, global_
 
     local, global_ = jax.jit(
-        jax.shard_map(
+        shard_map(
             f, mesh=mesh, in_specs=(P(DATA_AXIS), P()), out_specs=(P(DATA_AXIS), P()), check_vma=False
         )
     )(x, jax.random.key(3))
@@ -52,7 +53,7 @@ def test_shuffle_actually_permutes():
         return shuffle_gather(x, perm, DATA_AXIS)
 
     shuffled = jax.jit(
-        jax.shard_map(f, mesh=mesh, in_specs=(P(DATA_AXIS), P()), out_specs=P(DATA_AXIS), check_vma=False)
+        shard_map(f, mesh=mesh, in_specs=(P(DATA_AXIS), P()), out_specs=P(DATA_AXIS), check_vma=False)
     )(x, jax.random.key(0))
     assert not np.array_equal(np.asarray(shuffled), np.asarray(x))
     assert sorted(np.asarray(shuffled).ravel().tolist()) == list(range(16))
@@ -76,7 +77,7 @@ def test_balanced_shuffle_mixes_and_inverts():
         return y, back, counts[None]
 
     y, back, counts = jax.jit(
-        jax.shard_map(
+        shard_map(
             f,
             mesh=mesh,
             in_specs=P(DATA_AXIS),
@@ -101,7 +102,7 @@ def test_balanced_shuffle_changes_per_device_statistics():
         return jnp.mean(x, 0, keepdims=True), jnp.mean(y, 0, keepdims=True)
 
     mx, my = jax.jit(
-        jax.shard_map(
+        shard_map(
             f, mesh=mesh, in_specs=P(DATA_AXIS), out_specs=(P(DATA_AXIS), P(DATA_AXIS)), check_vma=False
         )
     )(x)

@@ -22,6 +22,7 @@ from moco_tpu.models import create_vit, sincos_2d_posembed
 from moco_tpu.parallel import create_mesh, shard_batch
 from moco_tpu.utils.config import DataConfig, MocoConfig, OptimConfig, TrainConfig
 from moco_tpu.utils.schedules import build_optimizer
+from moco_tpu.parallel.compat import shard_map
 
 IMG = 16  # 4x4 grid of 4px patches
 
@@ -227,7 +228,7 @@ class TestSequenceParallelViT:
             return vit_sp.apply(params, x)
 
         got = jax.jit(
-            jax.shard_map(
+            shard_map(
                 fwd, mesh=mesh, in_specs=(P(), P()), out_specs=P(), check_vma=False
             )
         )(params, x)
@@ -251,6 +252,7 @@ class TestSequenceParallelViT:
             ),
         )
 
+    @pytest.mark.slow  # full v3 SP step over the 8-dev mesh: heaviest compile in the suite
     def test_v3_train_step_with_sp_matches_dense(self):
         """One v3 step on a (4, 2) mesh with token-sharded ViT == the same
         step on (4, 1) dense — loss and updated params agree."""

@@ -81,6 +81,7 @@ def _run_steps(config: TrainConfig, n_steps: int = 2):
 
 
 @pytest.mark.parametrize("optimizer", ["sgd", "adamw"])
+@pytest.mark.slow  # replicated-vs-ZeRO A/B compiles both step programs per optimizer
 def test_zero_matches_replicated_update(optimizer):
     s_rep, l_rep = _run_steps(_config(zero=False, optimizer=optimizer))
     s_zero, l_zero = _run_steps(_config(zero=True, optimizer=optimizer))
@@ -89,6 +90,7 @@ def test_zero_matches_replicated_update(optimizer):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.slow  # compiles two full v3 steps over the mesh (~1 min on CPU)
 def test_zero_v3_step_runs_and_matches():
     s_rep, l_rep = _run_steps(_config(zero=False, optimizer="adamw", v3=True))
     s_zero, l_zero = _run_steps(_config(zero=True, optimizer="adamw", v3=True))
@@ -128,6 +130,7 @@ def test_zero_rejects_lars():
         make_train_step(config, encoder, tx, mesh, state_template=state)
 
 
+@pytest.mark.slow  # full step + probe-surgery chain
 def test_zero_checkpoint_restores_into_lincls(tmp_path):
     """A ZeRO-trained checkpoint must restore through the downstream
     template builders: the driver records the train-time mesh width in

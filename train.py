@@ -139,6 +139,24 @@ def build_parser() -> argparse.ArgumentParser:
         help="retain the last N checkpoints (default 3); 0 keeps every "
         "one — the reference's per-epoch retention (main_moco.py:~L275-280)",
     )
+    # fault tolerance (robustness layer)
+    p.add_argument(
+        "--watchdog-timeout", type=float, default=None,
+        help="seconds without a completed step before the stall watchdog "
+        "dumps all-thread stacks, writes an emergency checkpoint, and "
+        "exits nonzero (0 = off; first step gets a compile grace period)",
+    )
+    p.add_argument(
+        "--nan-guard-threshold", type=int, default=None,
+        help="abort after this many non-finite-loss log steps (each one "
+        "is skipped + counted in metrics.jsonl)",
+    )
+    p.add_argument(
+        "--faults", default=None,
+        help="deterministic fault-injection spec (chaos testing), e.g. "
+        "'ckpt_truncate@step=8,io@site=data.read:at=3,nan@step=6' — "
+        "same grammar as the MOCO_FAULTS env var",
+    )
     # parallel / infra
     p.add_argument("--num-data", type=int, default=None, help="data-axis size (default: all devices)")
     p.add_argument("--num-model", type=int, default=None, help="model-axis size (shards the queue)")
@@ -219,6 +237,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         knn_every_epochs=args.knn_every_epochs,
         checkpoint_async=args.checkpoint_async,
         checkpoint_keep=args.keep,
+        watchdog_timeout=args.watchdog_timeout,
+        nan_guard_threshold=args.nan_guard_threshold,
     )
 
 
@@ -228,6 +248,10 @@ def main() -> None:
 
     pin_platform_from_env()
     enable_persistent_compilation_cache()
+    if args.faults:
+        from moco_tpu.utils import faults
+
+        faults.install(args.faults)
     config = config_from_args(args)
     from moco_tpu.train import train
 
