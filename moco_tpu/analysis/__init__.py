@@ -1,0 +1,65 @@
+"""mocolint — JAX/TPU-aware static analysis for this repository.
+
+The invariants MoCo correctness and TPU throughput hang on are invisible
+to Python's type system: the key encoder must only move via EMA under
+`stop_gradient` (He et al., arXiv:1911.05722), PRNG keys must never be
+consumed twice, and the jitted hot path must contain zero host
+round-trips and zero recompile hazards — a stray `float(loss)` inside
+the step burns an hour of TPU time before anyone notices. `mocolint`
+checks these *before* the run:
+
+====  =========================================================
+Rule  Checks
+====  =========================================================
+JX001 impure calls (`time.*`, stdlib `random.*`, `print`, `global`
+      mutation) inside jit/shard_map-compiled functions
+JX002 implicit host transfer on traced values (`float()`, `int()`,
+      `bool()`, `np.asarray`, `.item()`) inside jitted scope
+JX003 PRNG key reuse — one key consumed by two samplers without an
+      interleaving `split`/`fold_in`
+JX004 recompile hazards — non-hashable literals in static args,
+      `static_argnames` not in the wrapped signature, Python
+      branching on `.shape` inside jitted scope
+JX005 key-encoder/queue tensors reaching a loss without
+      `stop_gradient` (the MoCo invariant; `ops/losses.py:36` and
+      `core/queue.py:37` are the known-good sanitizing patterns)
+JX006 `donate_argnums` buffers read again after the jitted call
+JX007 collective axis names inconsistent with the enclosing
+      `shard_map`/`pmap` axis declaration
+====  =========================================================
+
+Usage::
+
+    python -m moco_tpu.analysis moco_tpu/ scripts/ train.py
+    python -m moco_tpu.analysis moco_tpu/ --format json -o report.json
+
+Suppress a finding on its line with a justification::
+
+    x = balanced_unshuffle(rng, y)  # mocolint: disable=JX003  (involution reuses the key on purpose)
+
+The runtime arm (`moco_tpu/analysis/runtime.py`) complements the static
+pass inside the train driver: `--strict-tracing` turns on
+`jax.check_tracer_leaks`, surfaces a `compile_cache_misses` counter on
+every metrics.jsonl log line, and aborts when the step function
+recompiles after warm-up.
+"""
+
+from __future__ import annotations
+
+from moco_tpu.analysis.engine import (
+    Finding,
+    analyze_paths,
+    analyze_source,
+    iter_rules,
+    render_json,
+    render_text,
+)
+
+__all__ = [
+    "Finding",
+    "analyze_paths",
+    "analyze_source",
+    "iter_rules",
+    "render_json",
+    "render_text",
+]

@@ -1,0 +1,72 @@
+"""CLI: ``python -m moco_tpu.analysis [paths...]`` (a.k.a. mocolint).
+
+Exit status 0 when every finding is suppressed (or none exist), 1 when
+unsuppressed findings remain, 2 on usage errors — so CI can block on it
+directly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from moco_tpu.analysis.engine import (
+    analyze_paths,
+    iter_rules,
+    render_json,
+    render_text,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="mocolint",
+        description="JAX/TPU-aware static analysis for moco-tpu "
+        "(impure jitted code, host transfers, PRNG reuse, recompile "
+        "hazards, stop_gradient invariants, donation bugs, axis names)",
+    )
+    p.add_argument("paths", nargs="*", default=["moco_tpu"], help="files or directories")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("-o", "--output", default=None, help="write the report to a file")
+    p.add_argument(
+        "--rules", default=None,
+        help="comma-separated rule ids to run (default: all)",
+    )
+    p.add_argument(
+        "--show-suppressed", action="store_true",
+        help="include suppressed findings in text output",
+    )
+    p.add_argument("--list-rules", action="store_true")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for rule_id, summary in iter_rules():
+            print(f"{rule_id}  {summary}")
+        return 0
+    rules = None
+    if args.rules:
+        rules = [r.strip().upper() for r in args.rules.split(",") if r.strip()]
+        known = {rid for rid, _ in iter_rules()}
+        unknown = set(rules) - known
+        if unknown:
+            print(f"mocolint: unknown rule(s): {', '.join(sorted(unknown))}", file=sys.stderr)
+            return 2
+    findings = analyze_paths(args.paths, rules=rules)
+    report = (
+        render_json(findings)
+        if args.format == "json"
+        else render_text(findings, show_suppressed=args.show_suppressed)
+    )
+    if args.output:
+        with open(args.output, "w", encoding="utf-8") as fh:
+            fh.write(report + "\n")
+    if args.format == "text" or not args.output:
+        print(report)
+    return 1 if any(not f.suppressed for f in findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,172 @@
+"""mocolint engine: rule registry, suppression comments, reporting.
+
+Rules live in `moco_tpu/analysis/rules/` — one module per rule, each
+registering itself with :func:`rule`. A rule is a callable
+``(ModuleContext) -> Iterable[(ast_node_or_line, message)]``; the engine
+stamps rule id / path / position, applies suppression comments, and
+renders text or JSON.
+
+Suppression is per line, per rule::
+
+    risky_line()  # mocolint: disable=JX003  (why this is intentional)
+    other()       # mocolint: disable=JX001,JX002
+    anything()    # mocolint: disable=all
+
+Suppressed findings are kept (with ``suppressed=True``) so reports can
+audit them; only unsuppressed findings affect the exit code.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Callable, Iterable, Iterator, Optional, Union
+
+from moco_tpu.analysis.astutils import ModuleContext
+
+RuleResult = Iterable[tuple[Union[ast.AST, int], str]]
+RuleFn = Callable[[ModuleContext], RuleResult]
+
+_RULES: dict[str, tuple[str, RuleFn]] = {}
+
+_SUPPRESS_RE = re.compile(r"#\s*mocolint:\s*disable=([A-Za-z0-9_,\s]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    message: str
+    path: str
+    line: int
+    col: int = 0
+    suppressed: bool = False
+
+    def render(self) -> str:
+        tag = " (suppressed)" if self.suppressed else ""
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}{tag}"
+
+
+def rule(rule_id: str, summary: str) -> Callable[[RuleFn], RuleFn]:
+    """Decorator registering a rule under its JXnnn id."""
+
+    def deco(fn: RuleFn) -> RuleFn:
+        _RULES[rule_id] = (summary, fn)
+        return fn
+
+    return deco
+
+
+def _load_rules() -> None:
+    # importing the package registers every rule module
+    import moco_tpu.analysis.rules  # noqa: F401
+
+
+def iter_rules() -> list[tuple[str, str]]:
+    """[(rule_id, one-line summary)] for --list-rules and the README table."""
+    _load_rules()
+    return sorted((rid, summary) for rid, (summary, _) in _RULES.items())
+
+
+def _suppressed_rules(line: str) -> set[str]:
+    m = _SUPPRESS_RE.search(line)
+    if not m:
+        return set()
+    return {tok.strip().upper() for tok in m.group(1).split(",") if tok.strip()}
+
+
+def analyze_source(
+    source: str, path: str, rules: Optional[Iterable[str]] = None
+) -> list[Finding]:
+    """All findings (suppressed ones flagged, not dropped) for one file."""
+    _load_rules()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [
+            Finding(
+                rule="PARSE",
+                message=f"syntax error: {e.msg}",
+                path=path,
+                line=e.lineno or 1,
+                col=e.offset or 0,
+            )
+        ]
+    ctx = ModuleContext(tree, source, path)
+    selected = set(rules) if rules is not None else set(_RULES)
+    findings: list[Finding] = []
+    for rule_id, (_, fn) in sorted(_RULES.items()):
+        if rule_id not in selected:
+            continue
+        for node, message in fn(ctx):
+            line = node if isinstance(node, int) else getattr(node, "lineno", 1)
+            col = 0 if isinstance(node, int) else getattr(node, "col_offset", 0)
+            src_line = (
+                ctx.source_lines[line - 1] if 0 < line <= len(ctx.source_lines) else ""
+            )
+            suppressed_here = _suppressed_rules(src_line)
+            findings.append(
+                Finding(
+                    rule=rule_id,
+                    message=message,
+                    path=path,
+                    line=line,
+                    col=col,
+                    suppressed=rule_id.upper() in suppressed_here
+                    or "ALL" in suppressed_here,
+                )
+            )
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(
+                    d for d in dirs if d != "__pycache__" and not d.startswith(".")
+                )
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+        elif p.endswith(".py"):
+            yield p
+
+
+def analyze_paths(
+    paths: Iterable[str], rules: Optional[Iterable[str]] = None
+) -> list[Finding]:
+    findings: list[Finding] = []
+    for f in iter_python_files(paths):
+        with open(f, "r", encoding="utf-8") as fh:
+            findings.extend(analyze_source(fh.read(), f, rules=rules))
+    return findings
+
+
+def render_text(findings: list[Finding], show_suppressed: bool = False) -> str:
+    shown = [f for f in findings if show_suppressed or not f.suppressed]
+    lines = [f.render() for f in shown]
+    active = sum(1 for f in findings if not f.suppressed)
+    muted = len(findings) - active
+    lines.append(
+        f"mocolint: {active} finding(s)"
+        + (f", {muted} suppressed" if muted else "")
+    )
+    return "\n".join(lines)
+
+
+def render_json(findings: list[Finding]) -> str:
+    return json.dumps(
+        {
+            "version": 1,
+            "counts": {
+                "active": sum(1 for f in findings if not f.suppressed),
+                "suppressed": sum(1 for f in findings if f.suppressed),
+            },
+            "findings": [dataclasses.asdict(f) for f in findings],
+        },
+        indent=2,
+    )

@@ -1,0 +1,13 @@
+"""Rule modules — importing this package registers every rule with the
+engine. One module per rule id, each with paired known-bad/known-good
+fixtures under ``tests/fixtures/lint/``."""
+
+from moco_tpu.analysis.rules import (  # noqa: F401
+    jx001_impure,
+    jx002_host_transfer,
+    jx003_prng_reuse,
+    jx004_recompile,
+    jx005_stop_gradient,
+    jx006_donation,
+    jx007_axis_names,
+)

@@ -1,0 +1,54 @@
+"""JX001 — impure calls inside jit/shard_map-compiled functions.
+
+`time.*`, stdlib `random.*`, `print`, and `global` mutation execute at
+TRACE time only: the compiled program replays their first-call result
+(or nothing at all) on every subsequent step. A `time.perf_counter()`
+inside the step measures tracing, not the step; stdlib `random` bakes
+one sample into the executable; `print` fires once and then never again
+(and `jax.debug.print` is the working alternative). All of these are
+silent on CPU smoke runs and wrong on TPU.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from moco_tpu.analysis.astutils import ModuleContext, walk_own
+from moco_tpu.analysis.engine import rule
+
+# dotted-prefix -> why it's impure under trace
+_IMPURE_PREFIXES = {
+    "time.": "executes at trace time only (timing the trace, not the step)",
+    "random.": "stdlib RNG is baked in at trace time — use jax.random with an explicit key",
+    "os.environ": "environment reads are frozen at trace time",
+}
+
+
+@rule("JX001", "impure call (time.*/random.*/print/global mutation) inside jitted scope")
+def check(ctx: ModuleContext):
+    for fn in ctx.jitted:
+        for node in walk_own(fn):
+            if isinstance(node, ast.Global):
+                yield node, (
+                    f"`global {', '.join(node.names)}` inside jitted function "
+                    f"'{fn.name}': mutation happens once at trace time, never "
+                    "per step — thread state through the function instead"
+                )
+            if not isinstance(node, ast.Call):
+                continue
+            q = ctx.qual(node.func)
+            if q is None:
+                continue
+            if q == "print" and "print" not in ctx.imports:
+                yield node, (
+                    f"print() inside jitted function '{fn.name}' fires only at "
+                    "trace time — use jax.debug.print for per-step output"
+                )
+                continue
+            for prefix, why in _IMPURE_PREFIXES.items():
+                if q == prefix.rstrip(".") or q.startswith(prefix):
+                    yield node, (
+                        f"impure call {q}() inside jitted function "
+                        f"'{fn.name}': {why}"
+                    )
+                    break

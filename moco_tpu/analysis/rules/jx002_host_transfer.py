@@ -1,0 +1,75 @@
+"""JX002 — implicit host transfer on traced values inside jitted scope.
+
+`float(x)`, `int(x)`, `bool(x)`, `np.asarray(x)`, and `x.item()` on a
+traced array force a device->host sync: under `jit` they either raise a
+`ConcretizationTypeError` at trace time or — worse, when they sneak into
+a shape/static position — silently serialize the pipeline every step.
+The hot path must be transfer-free; cast with `jnp.asarray`/`astype` and
+read scalars on the host side of the step boundary (as the train driver
+does on log steps only).
+
+Shape-derived casts (`int(x.shape[0])`) and literal casts are static and
+exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from moco_tpu.analysis.astutils import ModuleContext, walk_own
+from moco_tpu.analysis.engine import rule
+
+_CAST_BUILTINS = {"float", "int", "bool"}
+_NUMPY_SINKS = {
+    "numpy.asarray",
+    "numpy.array",
+    "numpy.float32",
+    "numpy.float64",
+    "numpy.int32",
+    "numpy.int64",
+}
+_HOST_METHODS = {"item", "tolist", "block_until_ready"}
+
+
+def _is_static_cast(arg: ast.AST) -> bool:
+    """Casts of literals or of anything shape-derived are trace-static."""
+    if isinstance(arg, ast.Constant):
+        return True
+    for n in ast.walk(arg):
+        if isinstance(n, ast.Attribute) and n.attr in ("shape", "ndim", "size", "dtype"):
+            return True
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) and n.func.id == "len":
+            return True
+    return False
+
+
+@rule("JX002", "implicit host transfer (float()/int()/bool()/np.asarray/.item()) in jitted scope")
+def check(ctx: ModuleContext):
+    for fn in ctx.jitted:
+        for node in walk_own(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _HOST_METHODS
+                and not node.args
+            ):
+                yield node, (
+                    f".{node.func.attr}() inside jitted function '{fn.name}' "
+                    "forces a device->host transfer per step — keep scalars on "
+                    "device and fetch them outside the compiled region"
+                )
+                continue
+            q = ctx.qual(node.func)
+            if q in _CAST_BUILTINS and q not in ctx.imports and len(node.args) == 1:
+                if not _is_static_cast(node.args[0]):
+                    yield node, (
+                        f"{q}() on a traced value inside jitted function "
+                        f"'{fn.name}' is a host sync (or a trace error) — use "
+                        f"jnp casts / astype and read scalars outside the step"
+                    )
+            elif q in _NUMPY_SINKS:
+                yield node, (
+                    f"{q}() inside jitted function '{fn.name}' materializes a "
+                    "host array mid-trace — use jnp.asarray on device instead"
+                )

@@ -1,0 +1,129 @@
+"""JX003 — PRNG key reuse without an interleaving split/fold_in.
+
+A JAX PRNG key passed to two samplers yields IDENTICAL randomness at
+both sites — augmentations that repeat, dropout masks that correlate,
+permutations that undo themselves. Correct code threads keys through
+`jax.random.split` / `fold_in` so every consumer sees a fresh key.
+
+What counts:
+- key producers: `jax.random.PRNGKey/key`, the outputs of
+  `split`/`fold_in`/`clone`, and function parameters whose name contains
+  ``rng`` (the repo's naming idiom for keys);
+- derivations: `fold_in(key, data)` never consumes (deriving many
+  children from one parent with distinct data is the idiomatic pattern);
+  `split(key)` consumes — calling it twice on the same key returns the
+  same children;
+- consumption: the key appearing as a direct argument to any other call.
+
+The analysis is branch-aware (exclusive `if`/`else` arms don't sum) and
+runs loop bodies twice, so a key consumed once per iteration without
+re-derivation is caught.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from moco_tpu.analysis.astutils import FlowVisitor, ModuleContext, stmt_exprs
+from moco_tpu.analysis.engine import rule
+
+_PRODUCERS = {"jax.random.PRNGKey", "jax.random.key", "jax.random.wrap_key_data"}
+_DERIVE_NO_CONSUME = {"jax.random.fold_in", "jax.random.clone"}
+_DERIVE_CONSUME = {"jax.random.split"}
+_RNG_PARAM = re.compile(r"(^|_)rng(_|\d|$)|(^|_)prng(_|\d|$)")
+
+
+class _KeyFlow(FlowVisitor):
+    def __init__(self, ctx: ModuleContext):
+        self.ctx = ctx
+        self.findings: list[tuple[ast.AST, str]] = []
+        self._seen_lines: set[tuple[int, str]] = set()
+
+    def enter_function(self, fn: ast.FunctionDef, state) -> None:
+        args = fn.args
+        for a in [*args.posonlyargs, *args.args, *args.kwonlyargs]:
+            if _RNG_PARAM.search(a.arg):
+                state[a.arg] = (0, a.lineno)
+
+    def fork(self, state):
+        return dict(state)
+
+    def merge(self, a, b):
+        merged = dict(a)
+        for name, (count, line) in b.items():
+            if name in merged and merged[name][0] >= count:
+                continue
+            merged[name] = (count, line)
+        return merged
+
+    def _consume(self, name: str, node: ast.AST, state) -> None:
+        count, line = state[name]
+        if count >= 1:
+            key = (node.lineno, name)
+            if key not in self._seen_lines:
+                self._seen_lines.add(key)
+                self.findings.append(
+                    (
+                        node,
+                        f"PRNG key '{name}' consumed again (previous use at "
+                        f"line {line}) without an interleaving jax.random."
+                        "split/fold_in — both sites see identical randomness",
+                    )
+                )
+        state[name] = (count + 1, node.lineno)
+
+    def _scan_expr(self, expr: ast.AST, state) -> None:
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            q = self.ctx.qual(node.func)
+            if q in _DERIVE_NO_CONSUME:
+                continue
+            if q in _PRODUCERS:
+                continue
+            for arg in [*node.args, *[kw.value for kw in node.keywords]]:
+                if isinstance(arg, ast.Name) and arg.id in state:
+                    self._consume(arg.id, node, state)
+
+    def visit_stmt(self, stmt: ast.stmt, state) -> None:
+        for expr in stmt_exprs(stmt):
+            self._scan_expr(expr, state)
+        # (re)bindings AFTER consumption in the RHS
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = stmt.value
+            targets = (
+                stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+            )
+            produces = isinstance(value, ast.Call) and self.ctx.qual(value.func) in (
+                _PRODUCERS | _DERIVE_CONSUME | _DERIVE_NO_CONSUME
+            )
+            for t in targets:
+                names = (
+                    [t] if isinstance(t, ast.Name) else
+                    [e for e in getattr(t, "elts", []) if isinstance(e, ast.Name)]
+                )
+                for n in names:
+                    if produces:
+                        state[n.id] = (0, n.lineno)
+                    elif isinstance(value, ast.Name) and value.id in state:
+                        state[n.id] = state[value.id]  # alias keeps the count
+                    else:
+                        state.pop(n.id, None)
+
+
+@rule("JX003", "PRNG key consumed twice without an interleaving split/fold_in")
+def check(ctx: ModuleContext):
+    # nested defs are visited by the parent's flow walk (closures see the
+    # parent's keys); start walks only at top-of-chain functions
+    nested: set[ast.AST] = set()
+    for g in ctx.functions:
+        for n in ast.walk(g):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) and n is not g:
+                nested.add(n)
+    for fn in ctx.functions:
+        if fn in nested:
+            continue
+        visitor = _KeyFlow(ctx)
+        visitor.run(fn, {})
+        yield from visitor.findings

@@ -1,0 +1,141 @@
+"""JX004 — recompile hazards around `jax.jit` static arguments.
+
+Three hazards, all of which burn TPU time silently (every recompile of
+the r50/224 step costs minutes — PROFILE.md):
+
+1. `static_argnames` naming a parameter the wrapped function does not
+   have (or `static_argnums` out of range): jax ignores or errors
+   depending on version, and the intended argument stays traced — each
+   distinct value then recompiles.
+2. A non-hashable literal (list/dict/set) passed in a static position:
+   raises at best; a hashable-but-fresh object (tuple rebuilt per call
+   from arrays) recompiles every step.
+3. Python `if` on `.shape` inside jitted scope: legal (shapes are
+   static) but every distinct shape re-traces — on a pipeline with
+   ragged batches this is an unbounded compile loop.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from moco_tpu.analysis.astutils import ModuleContext, jit_kind, walk_own
+from moco_tpu.analysis.engine import rule
+
+
+def _static_spec(call: ast.Call) -> tuple[list[int], list[str]]:
+    """(static_argnums, static_argnames) literals of a jit call."""
+    nums: list[int] = []
+    names: list[str] = []
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, int):
+                    nums.append(n.value)
+        elif kw.arg == "static_argnames":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    names.append(n.value)
+    return nums, names
+
+
+def _param_names(fn: ast.FunctionDef) -> list[str]:
+    a = fn.args
+    return [p.arg for p in [*a.posonlyargs, *a.args]]
+
+
+def _nonhashable(expr: ast.AST) -> Optional[str]:
+    if isinstance(expr, (ast.List, ast.ListComp)):
+        return "list"
+    if isinstance(expr, (ast.Dict, ast.DictComp)):
+        return "dict"
+    if isinstance(expr, (ast.Set, ast.SetComp)):
+        return "set"
+    return None
+
+
+@rule("JX004", "recompile hazard: bad static_argnums/argnames or shape branching in jitted scope")
+def check(ctx: ModuleContext):
+    # --- (1)+(2): every jit(...) call with static args ------------------
+    jit_wrappers: dict[str, tuple[list[int], list[str]]] = {}
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call) and jit_kind(ctx.qual(node.func)) == "jit"):
+            continue
+        nums, names = _static_spec(node)
+        if not nums and not names:
+            continue
+        wrapped = None
+        if node.args and isinstance(node.args[0], ast.Name):
+            defs = ctx.defs_by_name.get(node.args[0].id, [])
+            wrapped = defs[-1] if defs else None
+        if wrapped is not None:
+            params = _param_names(wrapped)
+            has_varargs = wrapped.args.vararg is not None
+            for name in names:
+                if name not in params and wrapped.args.kwarg is None:
+                    yield node, (
+                        f"static_argnames {name!r} is not a parameter of "
+                        f"'{wrapped.name}' ({', '.join(params) or 'no args'}) — "
+                        "the intended argument stays traced and every distinct "
+                        "value recompiles"
+                    )
+            for num in nums:
+                if not has_varargs and num >= len(params):
+                    yield node, (
+                        f"static_argnums {num} is out of range for "
+                        f"'{wrapped.name}' ({len(params)} positional params)"
+                    )
+    # remember wrapper bindings: g = jax.jit(f, static_*) for call-site checks
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            call = node.value
+            if jit_kind(ctx.qual(call.func)) == "jit":
+                nums, names = _static_spec(call)
+                if (nums or names) and len(node.targets) == 1 and isinstance(
+                    node.targets[0], ast.Name
+                ):
+                    jit_wrappers[node.targets[0].id] = (nums, names)
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        # direct call of the jit expression: jax.jit(f, static_argnums=0)(x)
+        if isinstance(node.func, ast.Call) and jit_kind(ctx.qual(node.func.func)) == "jit":
+            nums, names = _static_spec(node.func)
+        elif isinstance(node.func, ast.Name) and node.func.id in jit_wrappers:
+            nums, names = jit_wrappers[node.func.id]
+        else:
+            continue
+        for i, arg in enumerate(node.args):
+            if i in nums:
+                kind = _nonhashable(arg)
+                if kind:
+                    yield arg, (
+                        f"non-hashable {kind} literal in static position {i} — "
+                        "static args must be hashable (tuple it) or the call "
+                        "raises/recompiles"
+                    )
+        for kw in node.keywords:
+            if kw.arg in names:
+                kind = _nonhashable(kw.value)
+                if kind:
+                    yield kw.value, (
+                        f"non-hashable {kind} literal for static arg "
+                        f"{kw.arg!r} — static args must be hashable"
+                    )
+
+    # --- (3): shape branching inside jitted scope -----------------------
+    for fn in ctx.jitted:
+        for node in walk_own(fn):
+            if not isinstance(node, (ast.If, ast.IfExp)):
+                continue
+            for n in ast.walk(node.test):
+                if isinstance(n, ast.Attribute) and n.attr == "shape":
+                    yield node, (
+                        f"Python branch on .shape inside jitted function "
+                        f"'{fn.name}': every distinct shape re-traces and "
+                        "recompiles — hoist the branch out of the compiled "
+                        "function or make the kernel shape-polymorphic"
+                    )
+                    break

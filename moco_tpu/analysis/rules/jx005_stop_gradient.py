@@ -1,0 +1,171 @@
+"""JX005 — key-encoder / queue tensors reaching a loss without stop_gradient.
+
+THE MoCo invariant (He et al., arXiv:1911.05722): the key encoder is
+updated only by EMA; no gradient may flow into `params_k` or the
+negative queue. In torch the reference enforces it with
+`torch.no_grad()` blocks; functionally there is no such scope — a key
+embedding that reaches the InfoNCE matmul un-stopped silently turns
+MoCo into end-to-end contrastive learning with a stale tower, which
+*trains* (loss falls!) but learns the wrong thing. Nothing at runtime
+catches it.
+
+Known-good sanitizing patterns this rule models:
+- `ops/losses.py:36` — `infonce_logits` stop-gradients `k` and `queue`
+  internally before the einsums;
+- `core/queue.py:37` — `enqueue` stop-gradients the key block before
+  the FIFO write.
+
+Taint: values produced from `params_k` / `batch_stats_k` arguments, or
+named `queue`. Sanitization: passing through `stop_gradient` (a
+rebinding like ``k = lax.stop_gradient(k)`` cleans the name).
+Sinks: `@` matmuls, `einsum` calls, and `cross_entropy` calls whose
+operand is tainted-and-unsanitized.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from moco_tpu.analysis.astutils import FlowVisitor, ModuleContext, stmt_exprs
+from moco_tpu.analysis.engine import rule
+
+# attribute reads of these are ALWAYS tainted (state.params_k can't be
+# sanitized in place); bare local names track through the flow state so
+# a `queue = stop_gradient(queue)` rebinding clears them
+_TAINT_ATTRS = {"params_k", "batch_stats_k", "queue"}
+_TAINT_PARAMS = {"params_k", "batch_stats_k", "queue"}
+
+# helpers that stop-gradient their key/queue inputs internally — the
+# known-good patterns; values built through them are clean
+_SANITIZERS = ("stop_gradient", "infonce_logits", "enqueue", "fused_infonce_loss")
+
+
+def _terminal_name(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _sanitized(ctx: ModuleContext, expr: ast.AST) -> bool:
+    """Does `expr` route its tensors through stop_gradient (or one of the
+    helpers known to apply it internally)?"""
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Call):
+            q = ctx.qual(n.func)
+            if q and (q in _SANITIZERS or q.endswith(tuple("." + s for s in _SANITIZERS))):
+                return True
+    return False
+
+
+class _TaintFlow(FlowVisitor):
+    """state: name -> line where it became tainted."""
+
+    def __init__(self, ctx: ModuleContext):
+        self.ctx = ctx
+        self.findings: list[tuple[ast.AST, str]] = []
+        self._seen: set[int] = set()
+
+    def enter_function(self, fn: ast.FunctionDef, state) -> None:
+        args = fn.args
+        for a in [*args.posonlyargs, *args.args, *args.kwonlyargs]:
+            if a.arg in _TAINT_PARAMS:
+                state[a.arg] = a.lineno
+
+    def fork(self, state):
+        return dict(state)
+
+    def merge(self, a, b):
+        return {**b, **a}
+
+    def _tainted_in(self, expr: ast.AST, state) -> str | None:
+        """First tainted name occurring in `expr`, unless the expression
+        routes through stop_gradient / a sanitizing helper."""
+        if _sanitized(self.ctx, expr):
+            return None
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Name) and n.id in state:
+                return n.id
+            if isinstance(n, ast.Attribute) and n.attr in _TAINT_ATTRS:
+                return n.attr
+        return None
+
+    def _source_taints(self, expr: ast.AST, state) -> bool:
+        """Does evaluating `expr` produce a key-derived value?  True for
+        calls taking params_k/batch_stats_k/queue, direct reads of them,
+        reads of tainted locals — unless routed through a sanitizer."""
+        return self._tainted_in(expr, state) is not None
+
+    def _flag(self, node: ast.AST, name: str, what: str) -> None:
+        if node.lineno in self._seen:
+            return
+        self._seen.add(node.lineno)
+        self.findings.append(
+            (
+                node,
+                f"key-encoder/queue tensor '{name}' flows into {what} without "
+                "stop_gradient — gradients would leak into the EMA tower "
+                "(MoCo invariant; see ops/losses.py:36, core/queue.py:37 for "
+                "the sanitizing patterns)",
+            )
+        )
+
+    def _scan_sinks(self, expr: ast.AST, state) -> bool:
+        fired = False
+        for node in ast.walk(expr):
+            if isinstance(node, ast.BinOp) and isinstance(node.op, ast.MatMult):
+                for side in (node.left, node.right):
+                    name = self._tainted_in(side, state)
+                    if name:
+                        self._flag(node, name, "a matmul feeding the loss")
+                        fired = True
+            elif isinstance(node, ast.Call):
+                q = self.ctx.qual(node.func) or ""
+                if q == "einsum" or q.endswith(".einsum"):
+                    for arg in node.args[1:]:  # skip the spec string
+                        name = self._tainted_in(arg, state)
+                        if name:
+                            self._flag(node, name, "an einsum feeding the loss")
+                            fired = True
+                elif q == "cross_entropy" or q.endswith(".cross_entropy"):
+                    for arg in node.args:
+                        name = self._tainted_in(arg, state)
+                        if name:
+                            self._flag(node, name, "cross_entropy")
+                            fired = True
+        return fired
+
+    def visit_stmt(self, stmt: ast.stmt, state) -> None:
+        fired = False
+        for expr in stmt_exprs(stmt):
+            fired = self._scan_sinks(expr, state) or fired
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = stmt.value
+            targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+            taints = not fired and value is not None and self._source_taints(value, state)
+            for t in targets:
+                names = (
+                    [t] if isinstance(t, ast.Name) else
+                    [e for e in getattr(t, "elts", []) if isinstance(e, ast.Name)]
+                )
+                for n in names:
+                    if taints:
+                        state[n.id] = n.lineno
+                    else:
+                        state.pop(n.id, None)
+
+
+@rule("JX005", "key-encoder/queue tensor reaches a loss without stop_gradient")
+def check(ctx: ModuleContext):
+    nested: set[ast.AST] = set()
+    for g in ctx.functions:
+        for n in ast.walk(g):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) and n is not g:
+                nested.add(n)
+    for fn in ctx.functions:
+        if fn in nested:
+            continue
+        visitor = _TaintFlow(ctx)
+        visitor.run(fn, {})
+        yield from visitor.findings

@@ -1,0 +1,115 @@
+"""JX006 — donated buffer used after the jitted call.
+
+`donate_argnums` hands the argument's device buffer to XLA for reuse:
+after the call the Python reference points at INVALIDATED memory.
+Reading it raises `RuntimeError: Array has been deleted` on backends
+that track it — and on backends/versions that don't, it reads garbage.
+The classic slip: `new_state = step(state, batch)` followed by a debug
+read of `state.step`.
+
+Detection: wrapper bindings `g = jax.jit(f, donate_argnums=...)`, then a
+flow walk of every function that calls `g` — positional args in donated
+slots become dead names; a later Load before rebinding is the finding.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from moco_tpu.analysis.astutils import FlowVisitor, ModuleContext, jit_kind, stmt_exprs
+from moco_tpu.analysis.engine import rule
+
+
+def _donated_nums(call: ast.Call) -> list[int]:
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            return [
+                n.value
+                for n in ast.walk(kw.value)
+                if isinstance(n, ast.Constant) and isinstance(n.value, int)
+            ]
+    return []
+
+
+class _DonationFlow(FlowVisitor):
+    """state: name -> line where it was donated (dead after that line)."""
+
+    def __init__(self, ctx: ModuleContext, wrappers: dict[str, list[int]]):
+        self.ctx = ctx
+        self.wrappers = wrappers
+        self.findings: list[tuple[ast.AST, str]] = []
+        self._seen: set[int] = set()
+
+    def fork(self, state):
+        return dict(state)
+
+    def merge(self, a, b):
+        return {**a, **b}
+
+    def visit_stmt(self, stmt: ast.stmt, state) -> None:
+        # reads of dead names first (RHS evaluates before rebinding)
+        newly_dead: dict[str, int] = {}
+        for expr in stmt_exprs(stmt):
+            for node in ast.walk(expr):
+                if (
+                    isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)
+                    and node.id in state
+                    and node.lineno not in self._seen
+                ):
+                    self._seen.add(node.lineno)
+                    self.findings.append(
+                        (
+                            node,
+                            f"'{node.id}' was donated to a jitted call at line "
+                            f"{state[node.id]} (donate_argnums) — its buffer is "
+                            "invalidated; reading it again raises or returns "
+                            "garbage",
+                        )
+                    )
+                if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                    nums = self.wrappers.get(node.func.id)
+                    if nums:
+                        for i, arg in enumerate(node.args):
+                            if i in nums and isinstance(arg, ast.Name):
+                                newly_dead[arg.id] = node.lineno
+        state.update(newly_dead)
+        # rebinding revives the name
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+            for t in targets:
+                names = (
+                    [t] if isinstance(t, ast.Name) else
+                    [e for e in getattr(t, "elts", []) if isinstance(e, ast.Name)]
+                )
+                for n in names:
+                    state.pop(n.id, None)
+
+
+@rule("JX006", "buffer passed via donate_argnums is read again after the jitted call")
+def check(ctx: ModuleContext):
+    wrappers: dict[str, list[int]] = {}
+    for node in ast.walk(ctx.tree):
+        if (
+            isinstance(node, ast.Assign)
+            and isinstance(node.value, ast.Call)
+            and jit_kind(ctx.qual(node.value.func)) == "jit"
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+        ):
+            nums = _donated_nums(node.value)
+            if nums:
+                wrappers[node.targets[0].id] = nums
+    if not wrappers:
+        return
+    nested: set[ast.AST] = set()
+    for g in ctx.functions:
+        for n in ast.walk(g):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) and n is not g:
+                nested.add(n)
+    for fn in ctx.functions:
+        if fn in nested:
+            continue
+        visitor = _DonationFlow(ctx, wrappers)
+        visitor.run(fn, {})
+        yield from visitor.findings

@@ -1,0 +1,193 @@
+"""JX007 — collective axis names vs the enclosing shard_map/pmap.
+
+A collective naming an axis the surrounding `shard_map`/`pmap` does not
+declare fails at trace time with an unbound-axis error — or, nastier,
+silently binds to a DIFFERENT axis of the same mesh when names are
+shuffled during a refactor (psum over 'model' where 'data' was meant
+reduces over the wrong replica group and *runs*). The repo's axis names
+live in `parallel/mesh.py` (`DATA_AXIS`/`MODEL_AXIS`) and must line up
+between the decorator's PartitionSpecs and the collectives inside
+(`parallel/shuffle.py`, `parallel/zero.py`, `parallel/dist.py`).
+
+The check is conservative: axis tokens are compared symbolically
+(`DATA_AXIS` to `DATA_AXIS`, "data" to "data", and constants resolve
+through module-level NAME = "str" assignments). A spec expression that
+cannot be resolved to PartitionSpec literals (e.g. built by a helper
+function) leaves the axis set open and the wrap unchecked — no guessing.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from moco_tpu.analysis.astutils import ModuleContext, jit_kind, qualname
+from moco_tpu.analysis.engine import rule
+
+_COLLECTIVES_AXIS_ARG1 = {
+    "psum", "pmean", "pmax", "pmin", "all_gather", "psum_scatter",
+    "all_to_all", "ppermute", "pshuffle", "axis_size",
+}
+_COLLECTIVES_AXIS_ARG0 = {"axis_index"}
+
+
+def _basename(qual: Optional[str]) -> str:
+    return (qual or "").rsplit(".", 1)[-1]
+
+
+def _is_pspec(qual: Optional[str]) -> bool:
+    return qual is not None and (
+        qual == "P" or _basename(qual) == "PartitionSpec"
+    )
+
+
+def _tokens_of(ctx: ModuleContext, expr: ast.AST) -> set[str]:
+    """Axis tokens in a spec/axis expression: string values plus symbol
+    names (symbols also resolve through module string constants)."""
+    tokens: set[str] = set()
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            tokens.add(n.value)
+        elif isinstance(n, ast.Name):
+            tokens.add(n.id)
+            if n.id in ctx.constants:
+                tokens.add(ctx.constants[n.id])
+    return tokens
+
+
+def _spec_tokens(
+    ctx: ModuleContext,
+    expr: ast.AST,
+    local_assigns: dict[str, ast.AST],
+    depth: int = 0,
+) -> tuple[set[str], bool]:
+    """(declared axis tokens, closed?) for an in_specs/out_specs
+    expression. Unresolvable names leave the world open."""
+    tokens: set[str] = set()
+    closed = True
+    consumed: set[int] = set()
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            for fn_part in ast.walk(node.func):
+                consumed.add(id(fn_part))
+            if _is_pspec(qualname(node.func, ctx.imports)):
+                for a in [*node.args, *[kw.value for kw in node.keywords]]:
+                    tokens |= _tokens_of(ctx, a)
+                    for part in ast.walk(a):
+                        consumed.add(id(part))
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and id(node) not in consumed:
+            if node.id in local_assigns and depth < 4:
+                t, c = _spec_tokens(
+                    ctx, local_assigns[node.id], local_assigns, depth + 1
+                )
+                tokens |= t
+                closed &= c
+            else:
+                closed = False
+    return tokens, closed
+
+
+def _axis_expr(ctx: ModuleContext, call: ast.Call) -> Optional[ast.AST]:
+    base = _basename(ctx.qual(call.func))
+    for kw in call.keywords:
+        if kw.arg == "axis_name":
+            return kw.value
+    if base in _COLLECTIVES_AXIS_ARG0 and call.args:
+        return call.args[0]
+    if base in _COLLECTIVES_AXIS_ARG1 and len(call.args) >= 2:
+        return call.args[1]
+    return None
+
+
+def _collectives(ctx: ModuleContext, fn: ast.FunctionDef):
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            base = _basename(ctx.qual(node.func))
+            if base in _COLLECTIVES_AXIS_ARG1 | _COLLECTIVES_AXIS_ARG0:
+                yield node
+
+
+@rule("JX007", "collective axis name not declared by the enclosing shard_map/pmap")
+def check(ctx: ModuleContext):
+    # name -> RHS of simple assignments, per enclosing function + module
+    module_assigns: dict[str, ast.AST] = {}
+    for node in ctx.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and isinstance(
+            node.targets[0], ast.Name
+        ):
+            module_assigns[node.targets[0].id] = node.value
+
+    def local_env(fn: Optional[ast.FunctionDef]) -> dict[str, ast.AST]:
+        env = dict(module_assigns)
+        if fn is not None:
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 and isinstance(
+                    node.targets[0], ast.Name
+                ):
+                    env[node.targets[0].id] = node.value
+        return env
+
+    # map each shard_map/pmap call to its enclosing function (for assigns)
+    enclosing: dict[int, ast.FunctionDef] = {}
+    for f in ctx.functions:
+        for n in ast.walk(f):
+            enclosing[id(n)] = f
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        kind = jit_kind(ctx.qual(node.func))
+        if kind not in ("shard_map", "pmap"):
+            continue
+        if not (node.args and isinstance(node.args[0], ast.Name)):
+            continue
+        defs = ctx.defs_by_name.get(node.args[0].id, [])
+        if not defs:
+            continue
+        wrapped = defs[-1]
+        env = local_env(enclosing.get(id(node)))
+
+        declared: set[str] = set()
+        closed = True
+        if kind == "pmap":
+            axis_kw = next(
+                (kw.value for kw in node.keywords if kw.arg == "axis_name"), None
+            )
+            if axis_kw is not None:
+                declared = _tokens_of(ctx, axis_kw)
+            # pmap with no axis_name declares no named axis: any
+            # collective inside is unbound — keep declared empty/closed
+        else:
+            spec_exprs = [
+                kw.value
+                for kw in node.keywords
+                if kw.arg in ("in_specs", "out_specs")
+            ]
+            spec_exprs += node.args[2:4]
+            if not spec_exprs:
+                closed = False
+            for expr in spec_exprs:
+                t, c = _spec_tokens(ctx, expr, env)
+                declared |= t
+                closed &= c
+        if not closed:
+            continue
+        for coll in _collectives(ctx, wrapped):
+            axis = _axis_expr(ctx, coll)
+            if axis is None:
+                continue
+            tokens = _tokens_of(ctx, axis)
+            if not tokens:
+                continue  # unresolvable axis expression: don't guess
+            if tokens & declared:
+                continue
+            pretty = next(iter(sorted(tokens)))
+            yield coll, (
+                f"collective {_basename(ctx.qual(coll.func))}(axis={pretty!r}) "
+                f"inside '{wrapped.name}' names an axis the enclosing "
+                f"{kind} does not declare "
+                f"(declared: {', '.join(sorted(declared)) or 'none'}) — "
+                "unbound axis error, or a silent wrong-axis reduction after "
+                "a rename"
+            )

@@ -1,0 +1,120 @@
+"""Runtime arm of mocolint: tracer-leak checking + recompile accounting.
+
+The static pass catches hazard *patterns*; this module catches the
+*events* on a live run, at zero step-loop cost (everything piggybacks on
+the driver's log-step host sync):
+
+- :func:`enable_strict_tracing` — turns on `jax.check_tracer_leaks`, so
+  a traced value escaping the compiled region (the classic source of
+  silent recompiles and "leaked tracer" crashes hours later) fails
+  loudly at the point of escape.
+- :class:`CompileMonitor` — counts compilations of the jitted step via
+  the executable cache (`_cache_size`), falling back to a process-wide
+  `jax.monitoring` compile-event counter on jax versions without it.
+  Surfaced as `compile_cache_misses` on every metrics.jsonl log line
+  under `--strict-tracing`.
+- :class:`RecompileGuard` — the abort-on-recompile-after-step-N guard:
+  warm-up steps may compile freely (first trace, donation variants); a
+  compile after that means a shape/dtype/static-arg leak in the input
+  pipeline, and every occurrence costs minutes of TPU time (PROFILE.md
+  r50/224 compile). Failing fast turns a silent 100x slowdown into a
+  diagnosable crash.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+
+def enable_strict_tracing() -> None:
+    """Fail loudly when a tracer escapes its trace (leaked into a
+    closure, a global, or host state). Debug-grade checking — opt-in via
+    `train.py --strict-tracing`."""
+    import jax
+
+    jax.config.update("jax_check_tracer_leaks", True)
+
+
+class _MonitoringCounter:
+    """Process-wide compile counter from jax.monitoring events (fallback
+    when the jitted callable does not expose its executable cache)."""
+
+    _installed: Optional["_MonitoringCounter"] = None
+
+    def __init__(self) -> None:
+        self.count = 0
+
+    @classmethod
+    def install(cls) -> "_MonitoringCounter":
+        if cls._installed is None:
+            counter = cls()
+
+            def _on_event(event: str, **kw) -> None:
+                if "compile" in event:
+                    counter.count += 1
+
+            import jax
+
+            jax.monitoring.register_event_listener(_on_event)
+            cls._installed = counter
+        return cls._installed
+
+
+class CompileMonitor:
+    """Compilation count of one jitted callable.
+
+    `misses()` is the number of distinct executables compiled so far —
+    exactly the number of times the step function was (re)traced. Stable
+    after warm-up on a healthy run; each later increment is a recompile
+    some input change triggered.
+    """
+
+    def __init__(self, fn: Callable):
+        self._fn = fn
+        self._cache_size = getattr(fn, "_cache_size", None)
+        self._fallback: Optional[_MonitoringCounter] = None
+        if not callable(self._cache_size):
+            self._cache_size = None
+            self._fallback = _MonitoringCounter.install()
+
+    def misses(self) -> int:
+        if self._cache_size is not None:
+            try:
+                return int(self._cache_size())
+            except Exception:
+                return 0
+        return self._fallback.count if self._fallback else 0
+
+
+class RecompileError(RuntimeError):
+    """The jitted step recompiled after the warm-up window."""
+
+
+class RecompileGuard:
+    """Abort-on-recompile-after-step-N.
+
+    `update(step, misses)` returns None while healthy. Past
+    `warmup_steps`, a growing miss count returns a human-readable
+    diagnosis string (the driver logs it to metrics.jsonl, then raises
+    :class:`RecompileError`). Counting is driven by the caller so the
+    check costs nothing between log steps.
+    """
+
+    def __init__(self, warmup_steps: int):
+        self.warmup_steps = warmup_steps
+        self.baseline: Optional[int] = None
+
+    def update(self, step: int, misses: int) -> Optional[str]:
+        if step <= self.warmup_steps or self.baseline is None:
+            self.baseline = misses
+            return None
+        if misses > self.baseline:
+            return (
+                f"step function recompiled after warm-up: {misses} compile "
+                f"cache misses at step {step} vs {self.baseline} at the end "
+                f"of warm-up (step {self.warmup_steps}) — look for varying "
+                "shapes/dtypes from the input pipeline, non-hashable or "
+                "fresh static args, or host branching on batch content "
+                "(run mocolint for the static pattern)"
+            )
+        return None

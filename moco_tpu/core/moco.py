@@ -569,7 +569,9 @@ def make_train_step(
             im_k_sh = balanced_shuffle(step_rng, im_k, DATA_AXIS)
             k_sh, stats_k = apply_encoder(params_k, state.batch_stats_k, im_k_sh)
             k_sh = l2_normalize(k_sh)
-            k_local = balanced_unshuffle(step_rng, k_sh, DATA_AXIS)
+            # the unshuffle must regenerate the SAME permutation as the
+            # shuffle above, so reusing step_rng is the contract, not a bug
+            k_local = balanced_unshuffle(step_rng, k_sh, DATA_AXIS)  # mocolint: disable=JX003
             k_global = lax.all_gather(k_local, DATA_AXIS).reshape(-1, cfg.dim)
         else:  # 'syncbn' (cross-replica BN handles decorrelation) or 'none'
             # key_bn_running_stats (EMAN, config.py rationale): the key

@@ -125,7 +125,9 @@ def _forward(q, k, queue, temperature, block_k, interpret):
 def _reference(q, k, queue, temperature):
     """Dense jnp oracle (and CPU fallback): same outputs."""
     pos = jnp.sum(q * k, axis=-1) / temperature
-    neg = q @ queue.T / temperature
+    # k/queue are detached by construction: infonce_stats' custom_vjp
+    # returns no cotangent for them (_vjp_bwd yields dq only)
+    neg = q @ queue.T / temperature  # mocolint: disable=JX005
     all_logits = jnp.concatenate([pos[:, None], neg], axis=1)
     lse = jax.nn.logsumexp(all_logits, axis=-1)
     above = jnp.sum(neg > pos[:, None], axis=-1).astype(jnp.int32)

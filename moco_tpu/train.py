@@ -135,6 +135,24 @@ def train(
         shuffle_rng, jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
     )
 
+    # Strict tracing (mocolint runtime arm): tracer-leak checking plus a
+    # compile-cache-miss counter over the jitted step, read only on log
+    # steps. The guard turns a silent recompile loop (minutes per compile
+    # on TPU) into a fast, diagnosable abort.
+    compile_monitor = None
+    recompile_guard = None
+    if config.strict_tracing:
+        from moco_tpu.analysis.runtime import (
+            CompileMonitor,
+            RecompileError,
+            RecompileGuard,
+            enable_strict_tracing,
+        )
+
+        enable_strict_tracing()
+        compile_monitor = CompileMonitor(step_fn)
+        recompile_guard = RecompileGuard(config.recompile_warmup_steps)
+
     # Graceful preemption (TPU VMs are frequently preemptible, typically
     # with a ~30 s SIGTERM grace window): the flag is checked inside the
     # STEP loop, so the save happens within seconds, not at the end of a
@@ -371,7 +389,25 @@ def train(
                             io_retries = retry.snapshot()
                             if io_retries:
                                 payload["io_retries"] = io_retries
+                            if compile_monitor is not None:
+                                # always present under --strict-tracing
+                                # (not only-when-nonzero like the fault
+                                # counters): dashboards watch it for
+                                # FLATNESS, and absence would read as 0
+                                misses = compile_monitor.misses()
+                                payload["compile_cache_misses"] = misses
                             writer.write(gstep, payload)
+                            if recompile_guard is not None:
+                                diagnosis = recompile_guard.update(gstep, misses)
+                                if diagnosis is not None:
+                                    writer.write(
+                                        gstep,
+                                        {"epoch": epoch,
+                                         "event": "recompile_after_warmup",
+                                         "compile_cache_misses": misses},
+                                    )
+                                    writer.fsync()
+                                    raise RecompileError(diagnosis)
                     end = time.perf_counter()
                 last_avg = {
                     "epoch": epoch,

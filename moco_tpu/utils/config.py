@@ -229,6 +229,17 @@ class TrainConfig:
     # it). 0 disables. Must exceed the worst-case log interval; the
     # first step additionally gets a compilation grace period.
     watchdog_timeout: float = 0.0
+    # Strict tracing mode (mocolint runtime arm, --strict-tracing):
+    # enables jax.check_tracer_leaks, surfaces a `compile_cache_misses`
+    # counter on every metrics.jsonl log line, and aborts when the step
+    # function recompiles after `recompile_warmup_steps` (each silent
+    # recompile of the r50/224 step costs minutes — PROFILE.md). Checked
+    # on log steps only, so the step loop stays sync-free.
+    strict_tracing: bool = False
+    # Steps during which compiles are free (first trace + donation
+    # variants); a compile-cache miss after this aborts under
+    # --strict-tracing.
+    recompile_warmup_steps: int = 8
 
 
 def config_to_dict(cfg: TrainConfig) -> dict:
@@ -267,6 +278,7 @@ def config_from_dict(d: dict) -> TrainConfig:
                 "seed", "workdir", "log_every", "checkpoint_every_epochs",
                 "checkpoint_async", "checkpoint_keep", "steps_per_epoch",
                 "nan_guard_threshold", "watchdog_timeout",
+                "strict_tracing", "recompile_warmup_steps",
             )
             if k in d
         },

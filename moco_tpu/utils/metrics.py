@@ -70,7 +70,15 @@ class MetricWriter:
     line being formatted — the retry/guard counters that land here are
     precisely the events one needs to post-mortem a killed run. `fsync`
     makes the tail durable across a host crash; the train driver calls
-    it at preemption/stall/abort, and `close` always does."""
+    it at preemption/stall/abort, and `close` always does.
+
+    Line schema (see README "metrics.jsonl line format"): `step`/`time`
+    always; training lines add `epoch`/`lr`/`loss`/`acc1`/`acc5`;
+    fault counters `nan_steps`/`decode_failures`/`io_retries` appear
+    only when nonzero; `compile_cache_misses` appears on every line
+    under `--strict-tracing` (dashboards watch it for flatness); event
+    lines carry `event` ("nonfinite_loss" | "stall" |
+    "recompile_after_warmup") instead of the metric fields."""
 
     def __init__(self, workdir: str, filename: str = "metrics.jsonl"):
         os.makedirs(workdir, exist_ok=True)

@@ -145,7 +145,9 @@ def probe_arm(arm: str, workdir: str, groups, batches: int, batch) -> dict:
 
         for name, k in (("aligned", k_aligned), ("shuffled", k_shuffled)):
             l_pos = jnp.sum(q * k, axis=1, keepdims=True)
-            l_neg = q @ queue.T
+            # evaluation-only probe: no grad is ever taken through these
+            # logits, so the detach invariant is vacuous here
+            l_neg = q @ queue.T  # mocolint: disable=JX005
             logits = jnp.concatenate([l_pos, l_neg], axis=1)
             acc[name].append(float((jnp.argmax(logits, axis=1) == 0).mean() * 100))
             sim[name].append(float(l_pos.mean()))

@@ -1,0 +1,30 @@
+"""Known-bad fixture for JX001: impure calls inside jitted scope.
+
+Lines carrying an expectation marker comment must each produce exactly
+one finding; tests/test_analysis.py compares rule ids and line numbers
+exactly. This file is parsed by the analyzer, never imported/executed.
+"""
+
+import random
+import time
+
+import jax
+
+COUNTER = 0
+
+
+@jax.jit
+def impure_step(x):
+    global COUNTER  # expect: JX001
+    t0 = time.perf_counter()  # expect: JX001
+    noise = random.random()  # expect: JX001
+    print("step", x)  # expect: JX001
+    return x * noise + t0
+
+
+def compiled_indirectly(x):
+    stamp = time.time()  # expect: JX001
+    return x + stamp
+
+
+run = jax.jit(compiled_indirectly)

@@ -1,0 +1,19 @@
+"""Known-good fixture for JX001: side effects stay on the host side,
+per-step device printing goes through jax.debug.print."""
+
+import time
+
+import jax
+
+
+@jax.jit
+def pure_step(x):
+    jax.debug.print("step {x}", x=x)
+    return x * 2
+
+
+def host_loop(xs):
+    t0 = time.perf_counter()  # host code: timing the loop is fine
+    outs = [pure_step(x) for x in xs]
+    print(f"ran {len(outs)} steps")
+    return outs, time.perf_counter() - t0

@@ -1,0 +1,14 @@
+"""Known-bad fixture for JX002: implicit host transfers in jitted scope."""
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def leaky_step(x):
+    total = float(x.sum())  # expect: JX002
+    first = int(x[0])  # expect: JX002
+    nonzero = bool(x.min())  # expect: JX002
+    host = np.asarray(x)  # expect: JX002
+    scalar = x.mean().item()  # expect: JX002
+    return total + first + nonzero + scalar + host.size

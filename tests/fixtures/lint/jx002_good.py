@@ -1,0 +1,16 @@
+"""Known-good fixture for JX002: shape-derived casts are static, scalar
+reads happen outside the compiled region."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def clean_step(x):
+    b = int(x.shape[0])  # shapes are trace constants: static, no sync
+    return jnp.asarray(x, jnp.float32) / b
+
+
+def host_read(metrics):
+    # device->host reads belong outside the jitted region (log steps)
+    return {k: float(v) for k, v in metrics.items()}

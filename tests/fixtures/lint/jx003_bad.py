@@ -1,0 +1,23 @@
+"""Known-bad fixture for JX003: PRNG keys consumed twice."""
+
+import jax
+
+
+def correlated_noise(rng):
+    a = jax.random.normal(rng, (4,))
+    b = jax.random.uniform(rng, (4,))  # expect: JX003
+    return a + b
+
+
+def cross_iteration_reuse(rng, n):
+    total = 0.0
+    for _ in range(n):
+        total += jax.random.normal(rng, ())  # expect: JX003
+    return total
+
+
+def double_split():
+    root_rng = jax.random.PRNGKey(0)
+    first = jax.random.split(root_rng, 2)
+    second = jax.random.split(root_rng, 2)  # expect: JX003
+    return first, second

@@ -1,0 +1,31 @@
+"""Known-good fixture for JX003: keys threaded through split/fold_in."""
+
+import jax
+
+
+def decorrelated_noise(rng):
+    a_rng, b_rng = jax.random.split(rng)
+    a = jax.random.normal(a_rng, (4,))
+    b = jax.random.uniform(b_rng, (4,))
+    return a + b
+
+
+def per_step_derivation(rng, n):
+    # fold_in with distinct data derives a fresh child per iteration;
+    # the parent key is never consumed directly
+    return [jax.random.normal(jax.random.fold_in(rng, i), ()) for i in range(n)]
+
+
+def rethreaded_loop(rng, n):
+    total = 0.0
+    for _ in range(n):
+        rng, sub_rng = jax.random.split(rng)
+        total += jax.random.normal(sub_rng, ())
+    return total
+
+
+def exclusive_branches(rng, flag):
+    # the two consumers are in exclusive branches: one use per trace
+    if flag:
+        return jax.random.normal(rng, ())
+    return jax.random.uniform(rng, ())

@@ -1,0 +1,24 @@
+"""Known-bad fixture for JX004: recompile hazards."""
+
+import jax
+
+
+def apply_fn(params, x):
+    return params["w"] @ x
+
+
+misnamed = jax.jit(apply_fn, static_argnames=("mode",))  # expect: JX004
+out_of_range = jax.jit(apply_fn, static_argnums=(5,))  # expect: JX004
+
+static_shaped = jax.jit(apply_fn, static_argnums=(1,))
+
+
+def call_with_list(params):
+    return static_shaped(params, [1, 2, 3])  # expect: JX004
+
+
+@jax.jit
+def shape_branching(x):
+    if x.shape[0] > 128:  # expect: JX004
+        return x[:128]
+    return x

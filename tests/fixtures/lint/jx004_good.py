@@ -1,0 +1,21 @@
+"""Known-good fixture for JX004: hashable statics that exist in the
+wrapped signature; shape reads (not branches) inside jitted scope."""
+
+import jax
+
+
+def apply_fn(params, x, mode):
+    return params["w"] @ x if mode == "train" else x
+
+
+run = jax.jit(apply_fn, static_argnames=("mode",))
+
+
+def call_sites(params, x):
+    return run(params, x, mode="train"), run(params, x, mode="eval")
+
+
+@jax.jit
+def shape_reader(x):
+    b = x.shape[0]  # reading shapes is static and fine; branching is not
+    return x * b

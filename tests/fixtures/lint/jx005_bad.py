@@ -1,0 +1,29 @@
+"""Known-bad fixture for JX005: key-encoder/queue tensors reach a loss
+without stop_gradient — the MoCo invariant violation that trains wrong
+silently (loss falls, gradients flow into the EMA tower)."""
+
+import jax
+import jax.numpy as jnp
+
+
+def cross_entropy(logits, labels):
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    true = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+    return jnp.mean(logz - true)
+
+
+def leaky_infonce(encoder, params_q, params_k, im_q, im_k, queue, temperature):
+    q = encoder(params_q, im_q)
+    k = encoder(params_k, im_k)  # key-encoder output, never detached
+    l_pos = jnp.einsum("nc,nc->n", q, k)  # expect: JX005
+    l_neg = q @ queue.T  # expect: JX005
+    return jnp.concatenate([l_pos[:, None], l_neg], axis=1) / temperature
+
+
+def leaky_direct(encoder, q, params_k, im_k, labels):
+    k = encoder(params_k, im_k)
+    return cross_entropy(q @ k.T, labels)  # expect: JX005
+
+
+def leaky_state_queue(q, state):
+    return q @ state.queue.T  # expect: JX005

@@ -1,0 +1,31 @@
+"""Known-good fixture for JX005: the sanitizing patterns of
+ops/losses.py:36 and core/queue.py:37 — stop_gradient before the loss."""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def cross_entropy(logits, labels):
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    true = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+    return jnp.mean(logz - true)
+
+
+def clean_infonce(encoder, params_q, params_k, im_q, im_k, queue, temperature):
+    q = encoder(params_q, im_q)
+    k = lax.stop_gradient(encoder(params_k, im_k))
+    queue = lax.stop_gradient(queue)
+    l_pos = jnp.einsum("nc,nc->n", q, k)
+    l_neg = q @ queue.T
+    return jnp.concatenate([l_pos[:, None], l_neg], axis=1) / temperature
+
+
+def clean_rebinding(encoder, q, params_k, im_k, labels):
+    k = encoder(params_k, im_k)
+    k = lax.stop_gradient(k)  # in-place rebinding clears the taint
+    return cross_entropy(q @ k.T, labels)
+
+
+def clean_state_queue(q, state):
+    return q @ lax.stop_gradient(state.queue).T

@@ -1,0 +1,18 @@
+"""Known-bad fixture for JX006: a donated buffer read after the call."""
+
+import jax
+
+
+def step_fn(state, batch):
+    return state + batch
+
+
+step = jax.jit(step_fn, donate_argnums=(0,))
+
+
+def train_loop(state, batches):
+    for batch in batches:
+        new_state = step(state, batch)
+        print(state.sum())  # expect: JX006
+        state = new_state
+    return state

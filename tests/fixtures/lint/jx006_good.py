@@ -1,0 +1,17 @@
+"""Known-good fixture for JX006: the donated name is immediately
+rebound to the call's result — the only safe way to use donation."""
+
+import jax
+
+
+def step_fn(state, batch):
+    return state + batch
+
+
+step = jax.jit(step_fn, donate_argnums=(0,))
+
+
+def train_loop(state, batches):
+    for batch in batches:
+        state = step(state, batch)
+    return state

@@ -1,0 +1,25 @@
+"""Known-bad fixture for JX007: collectives naming axes the enclosing
+shard_map/pmap does not declare."""
+
+import jax
+from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+
+def wrong_axis_step(x):
+    return lax.psum(x, "model")  # expect: JX007
+
+
+def build_shard_map(mesh):
+    return shard_map(
+        wrong_axis_step, mesh=mesh, in_specs=(P("data"),), out_specs=P("data")
+    )
+
+
+def wrong_pmap_step(x):
+    return lax.pmean(x, "j")  # expect: JX007
+
+
+def build_pmap():
+    return jax.pmap(wrong_pmap_step, axis_name="i")

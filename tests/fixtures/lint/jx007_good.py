@@ -1,0 +1,28 @@
+"""Known-good fixture for JX007: collectives and specs agree, both via
+string literals and via symbolic axis-name constants."""
+
+import jax
+from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+DATA_AXIS = "data"
+
+
+def psum_step(x):
+    return lax.psum(x, DATA_AXIS)
+
+
+def build_shard_map(mesh):
+    batch_spec = P(DATA_AXIS)
+    return shard_map(
+        psum_step, mesh=mesh, in_specs=(batch_spec,), out_specs=P()
+    )
+
+
+def pmap_step(x):
+    return lax.pmean(x, "i")
+
+
+def build_pmap():
+    return jax.pmap(pmap_step, axis_name="i")

@@ -152,6 +152,17 @@ def build_parser() -> argparse.ArgumentParser:
         "is skipped + counted in metrics.jsonl)",
     )
     p.add_argument(
+        "--strict-tracing", action="store_true", default=None,
+        help="mocolint runtime arm: enable jax.check_tracer_leaks, report "
+        "compile_cache_misses on every metrics.jsonl log line, and abort "
+        "if the step function recompiles after the warm-up window",
+    )
+    p.add_argument(
+        "--recompile-warmup", type=int, default=None,
+        help="with --strict-tracing: steps during which compiles are free "
+        "(first trace); a compile-cache miss after this aborts (default 8)",
+    )
+    p.add_argument(
         "--faults", default=None,
         help="deterministic fault-injection spec (chaos testing), e.g. "
         "'ckpt_truncate@step=8,io@site=data.read:at=3,nan@step=6' — "
@@ -239,6 +250,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         checkpoint_keep=args.keep,
         watchdog_timeout=args.watchdog_timeout,
         nan_guard_threshold=args.nan_guard_threshold,
+        strict_tracing=args.strict_tracing,
+        recompile_warmup_steps=args.recompile_warmup,
     )
 
 
