@@ -37,6 +37,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from moco_tpu.core.ema import ema_update
 from moco_tpu.core.queue import check_queue_divisibility, enqueue, init_queue
+from moco_tpu.obs import health as obs_health
 from moco_tpu.models import ProjectionHead, V3MLPHead, create_resnet
 from moco_tpu.ops.losses import cross_entropy, infonce_logits, l2_normalize, topk_accuracy
 from moco_tpu.parallel.compat import shard_map
@@ -340,6 +341,10 @@ def make_train_step(
     (host- or device-side); sharded over the `data` axis.
     """
     cfg = config.moco
+    # Training-health gauges (obs/health.py) computed inside the jitted
+    # step and returned through the metrics dict — the host only ever
+    # sees them on log steps, riding the existing fetch.
+    health_on = config.health_metrics
     if cfg.key_bn_running_stats:
         # before the v3/predictor checks: the flag conflict is the more
         # fundamental config error and must be the one reported
@@ -474,10 +479,10 @@ def make_train_step(
             q1, q2 = jnp.split(l2_normalize(preds), 2, axis=0)
             loss1, logits = ctr(q1, k2_g)
             loss2, _ = ctr(q2, k1_g)
-            return loss1 + loss2, (stats_q, stats_pred, logits)
+            return loss1 + loss2, (stats_q, stats_pred, logits, q1)
 
         trainable = {"enc": state.params_q, "pred": state.params_pred}
-        (loss, (stats_q, stats_pred, logits)), grads = jax.value_and_grad(
+        (loss, (stats_q, stats_pred, logits, q1)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(trainable)
         if cfg.freeze_patch_embed and "patch_embed" in grads["enc"].get("backbone", {}):
@@ -525,6 +530,15 @@ def make_train_step(
                     jnp.zeros_like, updates["enc"]["backbone"]["patch_embed"]
                 )
             new_trainable = optax.apply_updates(trainable, updates)
+        if health_on:
+            # batch-local stats pmean over data; drift is a function of
+            # replicated params (v3 has no queue, so no staleness gauges)
+            hlocal = {
+                **obs_health.logit_stats_from_dense(logits, labels),
+                **obs_health.feature_stats(q1),
+            }
+            metrics.update(lax.pmean(hlocal, DATA_AXIS))
+            metrics.update(obs_health.ema_drift(new_trainable["enc"], params_k))
         new_state = state.replace(
             step=state.step + 1,
             params_q=new_trainable["enc"],
@@ -625,10 +639,10 @@ def make_train_step(
                 labels = rank * local_b + jnp.arange(local_b, dtype=jnp.int32)
                 loss = cross_entropy(logits, labels)
                 acc = topk_accuracy(logits, labels)
-            return loss, (stats_q, acc)
+            return loss, (stats_q, acc, q)
 
         trainable = {"enc": state.params_q, "pred": state.params_pred}
-        (loss, (stats_q, acc)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        (loss, (stats_q, acc, q_feats)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             trainable
         )
 
@@ -695,6 +709,34 @@ def make_train_step(
                 queue, queue_ptr = enqueue(state.queue, state.queue_ptr, k_global)
         else:
             queue, queue_ptr = state.queue, state.queue_ptr
+
+        # (7) Training-health gauges (obs/health.py), identical math on
+        # the fused and dense paths: positives recomputed from the
+        # (q, k) diagonal; negatives from a bounded queue sample (the
+        # full K-row pass is exactly what the fused kernel avoids
+        # materializing), in post-temperature units.
+        if health_on:
+            q_h = lax.stop_gradient(q_feats)
+            pos_l = jnp.sum(q_h * k_local, axis=-1) / cfg.temperature
+            if cfg.num_negatives:
+                rows = min(1024, state.queue.shape[0])
+                neg_ref = lax.stop_gradient(state.queue[:rows])
+            else:
+                # queue-free: the gathered key batch is the negative set
+                # (contains each row's own positive — 1/B_global of the
+                # sample, negligible contamination for a gauge)
+                neg_ref = k_global
+            neg_l = (q_h @ neg_ref.T) / cfg.temperature
+            hlocal = {
+                **obs_health.logit_stats(pos_l, neg_l),
+                **obs_health.feature_stats(q_h),
+            }
+            metrics.update(lax.pmean(hlocal, DATA_AXIS))
+            metrics.update(obs_health.ema_drift(params_q, params_k))
+            if cfg.num_negatives:
+                metrics.update(
+                    obs_health.queue_age(state.step, cfg.num_negatives, global_batch)
+                )
 
         new_state = state.replace(
             step=state.step + 1,

@@ -42,6 +42,7 @@ from moco_tpu.data.augment import (
     two_crop_augment,
 )
 from moco_tpu.data.datasets import build_dataset
+from moco_tpu.obs.trace import span as obs_span
 from moco_tpu.parallel.dist import ProcessDataPartition
 from moco_tpu.parallel.mesh import DATA_AXIS
 from moco_tpu.utils import faults, retry
@@ -141,7 +142,11 @@ class _HostPipeline:
                 np.asarray([l for _, l in loads], np.int32),
             )
 
-        return retry.retry_call(_load, site="data.read")
+        # span lands on the prefetch producer's thread track: decode
+        # time that OVERLAPS the train step is visible as such in the
+        # trace, instead of inflating the step's apparent data wait
+        with obs_span("host_decode", n=len(indices)):
+            return retry.retry_call(_load, site="data.read")
 
     @property
     def decode_failures(self) -> int:
@@ -197,14 +202,15 @@ class _HostPipeline:
         boxes = rrc_boxes_from_uniforms(
             u_local, np.repeat(dims, n_crops, axis=0), scale=scale
         ).reshape(len(local_idx), n_crops, 4)
-        raw, labels = retry.retry_call(
-            self.dataset.load_crop_batch,
-            local_idx,
-            boxes,
-            out_size,
-            pool=self._pool,
-            site="data.read",
-        )
+        with obs_span("host_decode", n=len(local_idx), crops=n_crops):
+            raw, labels = retry.retry_call(
+                self.dataset.load_crop_batch,
+                local_idx,
+                boxes,
+                out_size,
+                pool=self._pool,
+                site="data.read",
+            )
         # assemble per crop on the HOST side: slicing the crop axis of an
         # already-assembled global array would not be fully-addressable
         # under multi-host
@@ -255,10 +261,16 @@ class TwoCropPipeline(_HostPipeline):
                         scale=self.recipe.crop_scale,
                         out_size=self.config.image_size,
                     )  # two (B, S, S, 3) sharded views
-                    yield self._augment_precropped(step_rng, q_raw, k_raw)
+                    # span closed BEFORE the yield: a generator suspends
+                    # inside `with`, which would bill consumer time to it
+                    with obs_span("augment_dispatch", step=step):
+                        out = self._augment_precropped(step_rng, q_raw, k_raw)
+                    yield out
                 else:
                     raw, _ = self._put_batch(idx)
-                    yield self._augment(step_rng, raw)
+                    with obs_span("augment_dispatch", step=step):
+                        out = self._augment(step_rng, raw)
+                    yield out
 
         return _prefetch(gen(), depth=2)
 

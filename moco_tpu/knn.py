@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from moco_tpu.obs.trace import span as obs_span
 from moco_tpu.ops.losses import l2_normalize
 from moco_tpu.parallel.mesh import DATA_AXIS
 
@@ -129,11 +130,15 @@ def knn_eval(
 ) -> float:
     """kNN top-1 (%) of frozen features — the cheap probe proxy.
     `mesh` data-parallelizes feature extraction over its `data` axis."""
-    train_f, train_y = extract_features(
-        backbone, params, batch_stats, train_dataset, batch_size, image_size, mesh=mesh
-    )
-    test_f, test_y = extract_features(
-        backbone, params, batch_stats, test_dataset, batch_size, image_size, mesh=mesh
-    )
-    preds = knn_classify(train_f, train_y, test_f, num_classes, k, temperature)
-    return float(100.0 * np.mean(preds == test_y))
+    with obs_span("knn_eval", bank=len(train_dataset), test=len(test_dataset)):
+        with obs_span("knn_extract_bank"):
+            train_f, train_y = extract_features(
+                backbone, params, batch_stats, train_dataset, batch_size, image_size, mesh=mesh
+            )
+        with obs_span("knn_extract_test"):
+            test_f, test_y = extract_features(
+                backbone, params, batch_stats, test_dataset, batch_size, image_size, mesh=mesh
+            )
+        with obs_span("knn_classify"):
+            preds = knn_classify(train_f, train_y, test_f, num_classes, k, temperature)
+        return float(100.0 * np.mean(preds == test_y))

@@ -1,0 +1,178 @@
+"""MoCo training-health reductions — jit-compatible, computed IN the step.
+
+The contrastive-learning literature says the signals that predict a
+failed MoCo run are invisible in the loss curve: key-encoder/EMA drift
+and momentum scaling ("How to Scale Your EMA", arXiv:2307.13813),
+momentum-encoder representation dynamics (arXiv:2208.05744), dictionary
+staleness (the MoCo paper's consistency argument), and feature-norm
+collapse (all representations converging to one point — InfoNCE can
+plateau at a healthy-looking value while features die).
+
+Every function here is a pure jnp reduction over values the train step
+already has in registers, returned through the step's metrics dict —
+NOT a host-side recomputation. The host only sees the scalars on log
+steps, riding the existing metrics fetch (zero extra device syncs).
+
+Conventions: logits are reported in post-temperature units (what the
+softmax sees); drift is RELATIVE (`||q - k|| / ||q||`) so it is
+comparable across layer groups of different scale; queue ages are in
+STEPS (multiply by steps-per-second for wall time).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _tree_sq_norm(tree) -> jax.Array:
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    return sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves)
+
+
+def ema_drift(params_q, params_k) -> dict:
+    """Relative L2 drift between the query and key (EMA) encoders:
+    global plus one gauge per top-level layer group (backbone, head,
+    ...). A drift collapsing to 0 means the EMA momentum is too high to
+    track learning (or learning stopped); a drift exploding means the
+    key encoder no longer provides consistent dictionary keys — the
+    failure mode arXiv:2307.13813's momentum-scaling rule prevents."""
+    eps = 1e-12
+    out = {}
+    diff_sq = ref_sq = jnp.zeros((), jnp.float32)
+    for group in params_q:
+        d = _tree_sq_norm(
+            jax.tree.map(lambda q, k: q - k, params_q[group], params_k[group])
+        )
+        r = _tree_sq_norm(params_q[group])
+        out[f"ema_drift/{group}"] = jnp.sqrt(d) / (jnp.sqrt(r) + eps)
+        diff_sq = diff_sq + d
+        ref_sq = ref_sq + r
+    out["ema_drift"] = jnp.sqrt(diff_sq) / (jnp.sqrt(ref_sq) + eps)
+    return out
+
+
+def logit_stats(pos_logits: jax.Array, neg_logits: jax.Array) -> dict:
+    """Mean/std of the positive and negative InfoNCE logits (post-
+    temperature). The healthy pattern is a widening pos/neg margin;
+    pos ≈ neg means the dictionary is not discriminative, and both
+    saturating near 1/temperature flags feature collapse (all cosines
+    → 1)."""
+    pos = pos_logits.astype(jnp.float32)
+    neg = neg_logits.astype(jnp.float32)
+    return {
+        "logit_pos_mean": jnp.mean(pos),
+        "logit_pos_std": jnp.std(pos),
+        "logit_neg_mean": jnp.mean(neg),
+        "logit_neg_std": jnp.std(neg),
+    }
+
+
+def logit_stats_from_dense(logits: jax.Array, labels: jax.Array) -> dict:
+    """`logit_stats` from an already-materialized (B, N) logit matrix
+    whose positive sits at column `labels[b]` (the v3 symmetric loss and
+    the dense v2 path). Negatives are everything else; their mean/std
+    come from sum/sum-of-squares with the positives subtracted — no
+    (B, N) boolean mask materialization."""
+    lg = logits.astype(jnp.float32)
+    b, n = lg.shape
+    pos = jnp.take_along_axis(lg, labels[:, None].astype(jnp.int32), axis=1)[:, 0]
+    n_neg = jnp.asarray(b * (n - 1), jnp.float32)
+    neg_mean = (jnp.sum(lg) - jnp.sum(pos)) / n_neg
+    neg_sq = (jnp.sum(jnp.square(lg)) - jnp.sum(jnp.square(pos))) / n_neg
+    neg_std = jnp.sqrt(jnp.maximum(neg_sq - jnp.square(neg_mean), 0.0))
+    return {
+        "logit_pos_mean": jnp.mean(pos),
+        "logit_pos_std": jnp.std(pos),
+        "logit_neg_mean": neg_mean,
+        "logit_neg_std": neg_std,
+    }
+
+
+def feature_stats(feats: jax.Array) -> dict:
+    """Collapse detector on the step's (L2-normalized) query features.
+
+    `feature_std`: per-dimension std across the batch, averaged over
+    dimensions. For d-dim features uniform on the unit sphere this sits
+    near 1/sqrt(d); a slide toward 0 means the batch is converging to a
+    single direction — dimensional collapse — while the InfoNCE loss
+    can still look busy. `feature_dim_active` counts dimensions whose
+    std is above 10% of the uniform-sphere value (coarse effective-rank
+    gauge)."""
+    f = feats.astype(jnp.float32)
+    std = jnp.std(f, axis=0)  # (dim,)
+    uniform = 1.0 / jnp.sqrt(jnp.asarray(f.shape[-1], jnp.float32))
+    return {
+        "feature_std": jnp.mean(std),
+        "feature_dim_active": jnp.sum(std > 0.1 * uniform).astype(jnp.float32),
+    }
+
+
+def queue_age(
+    step: jax.Array, num_negatives: int, global_batch: int, num_buckets: int = 8
+) -> dict:
+    """Age distribution of the enqueued keys, in steps.
+
+    The FIFO writes `global_batch` keys per step, so the dictionary
+    holds the last K/B batches; the batch enqueued j steps ago has age
+    j. Early in training (step < K/B) the older slots still hold their
+    random init — their age is capped at `step` (they are as stale as
+    the run is old). All quantities derive from `step` and the static
+    (K, B), so this costs a handful of scalar ops, yet it makes
+    dictionary staleness — MoCo's central consistency trade-off — a
+    first-class, plottable signal.
+
+    Returns `queue_age_mean`, `queue_age_max` (steps) and
+    `queue_age_hist` (fraction of keys per age bucket, oldest last;
+    fixed `num_buckets` length so the JSONL schema is stable)."""
+    depth = max(num_negatives // max(global_batch, 1), 1)  # batches held
+    ages = jnp.minimum(jnp.arange(1, depth + 1, dtype=jnp.float32), step.astype(jnp.float32))
+    edges = jnp.linspace(0.0, float(depth), num_buckets + 1)
+    # bucket membership via searchsorted (jnp.histogram is fine too, but
+    # this keeps the bucket count static and the dtype explicit)
+    bucket = jnp.clip(jnp.searchsorted(edges, ages, side="right") - 1, 0, num_buckets - 1)
+    hist = jnp.zeros((num_buckets,), jnp.float32).at[bucket].add(1.0) / depth
+    return {
+        "queue_age_mean": jnp.mean(ages),
+        "queue_age_max": jnp.max(ages),
+        "queue_age_hist": hist,
+    }
+
+
+def health_summary(
+    params_q,
+    params_k,
+    feats_q: jax.Array,
+    pos_logits: jax.Array,
+    neg_logits: jax.Array,
+    step: jax.Array,
+    num_negatives: int = 0,
+    global_batch: int = 0,
+) -> dict:
+    """One-call bundle for the train step: EMA drift + logit stats +
+    collapse gauges (+ queue staleness when a queue exists). All values
+    are jnp scalars/arrays; the caller merges them into the step's
+    metrics dict (and pmean's the batch-local ones)."""
+    out = {}
+    out.update(ema_drift(params_q, params_k))
+    out.update(logit_stats(pos_logits, neg_logits))
+    out.update(feature_stats(feats_q))
+    if num_negatives and global_batch:
+        out.update(queue_age(step, num_negatives, global_batch))
+    return out
+
+
+# Keys whose values are batch-local statistics (must be pmean'd over the
+# data axis); the rest are functions of replicated state and need no
+# reduction. The split lives here so the step function can't drift out
+# of sync with the metric definitions.
+BATCH_LOCAL_KEYS = (
+    "logit_pos_mean",
+    "logit_pos_std",
+    "logit_neg_mean",
+    "logit_neg_std",
+    "feature_std",
+    "feature_dim_active",
+)

@@ -1,0 +1,183 @@
+"""The metrics.jsonl line schema, as code.
+
+README's "metrics.jsonl line format" section is the human contract;
+this module is the machine-checkable one — the golden schema test
+(tests/test_obs.py), `scripts/obs_smoke.py`, and `scripts/obs_report.py
+--strict` all validate against it, so the README can't silently rot.
+
+Line kinds (all carry `step` int + `time` float):
+
+- *training lines*: `loss` present -> require `epoch`/`lr`/`acc1`/
+  `acc5`; optionally the step-time breakdown (`t_data`/`t_step`, and
+  `t_dispatch`/`t_device` on probe-sampled lines), device-memory gauges
+  (`hbm_live_bytes`/`hbm_peak_bytes`, number or null), health gauges
+  (`ema_drift*`, `logit_*`, `feature_*`, `queue_age_*`), and the fault
+  counters (`nan_steps`/`decode_failures`/`io_retries` when nonzero,
+  `compile_cache_misses` under --strict-tracing);
+- *event lines*: `event` in EVENT_KINDS instead of the metric fields;
+- *aux lines*: neither (e.g. the periodic `knn_top1` line).
+
+Numbers are finite or null — NaN/Inf literals are rejected at parse
+time (`loads_strict`), matching the writer's scrubbing.
+
+Deliberately stdlib-only so report tooling can import it anywhere.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Iterable
+
+EVENT_KINDS = frozenset(
+    {"nonfinite_loss", "stall", "recompile_after_warmup"}
+)
+
+TRAIN_REQUIRED = ("epoch", "lr", "loss", "acc1", "acc5")
+
+# field -> validator; a field listed here, when present, must satisfy it
+_NUMBER = (int, float)
+
+
+def _num(v: Any) -> bool:
+    return isinstance(v, _NUMBER) and not isinstance(v, bool)
+
+
+def _num_or_null(v: Any) -> bool:
+    return v is None or _num(v)
+
+
+def _int_like(v: Any) -> bool:
+    return isinstance(v, int) and not isinstance(v, bool)
+
+
+def _num_list(v: Any) -> bool:
+    return isinstance(v, list) and all(_num_or_null(x) for x in v)
+
+
+def _counter_map(v: Any) -> bool:
+    return isinstance(v, dict) and all(
+        isinstance(k, str) and _int_like(n) for k, n in v.items()
+    )
+
+
+FIELD_VALIDATORS = {
+    "step": _int_like,
+    "time": _num,
+    "epoch": _int_like,
+    "lr": _num_or_null,
+    "loss": _num_or_null,
+    "acc1": _num_or_null,
+    "acc5": _num_or_null,
+    "knn_top1": _num_or_null,
+    # step-time breakdown (obs/stepstats.py)
+    "t_data": _num,
+    "t_step": _num,
+    "t_dispatch": _num_or_null,
+    "t_device": _num,
+    # device memory gauges (null where the backend lacks memory_stats)
+    "hbm_live_bytes": _num_or_null,
+    "hbm_peak_bytes": _num_or_null,
+    # MoCo health gauges (obs/health.py)
+    "ema_drift": _num_or_null,
+    "logit_pos_mean": _num_or_null,
+    "logit_pos_std": _num_or_null,
+    "logit_neg_mean": _num_or_null,
+    "logit_neg_std": _num_or_null,
+    "feature_std": _num_or_null,
+    "feature_dim_active": _num_or_null,
+    "queue_age_mean": _num_or_null,
+    "queue_age_max": _num_or_null,
+    "queue_age_hist": _num_list,
+    # fault-tolerance counters (present only when nonzero)
+    "nan_steps": _int_like,
+    "decode_failures": _int_like,
+    "io_retries": _counter_map,
+    # mocolint runtime arm (present on every line under --strict-tracing)
+    "compile_cache_misses": _int_like,
+    "watchdog_timeout": _num,
+}
+
+
+def _reject_nonfinite(val: str):
+    raise ValueError(f"non-finite JSON literal {val!r} (writer must scrub to null)")
+
+
+def loads_strict(line: str) -> dict:
+    """json.loads that rejects NaN/Infinity literals — the writer's
+    scrub-to-null contract, enforced at parse time."""
+    rec = json.loads(line, parse_constant=_reject_nonfinite)
+    if not isinstance(rec, dict):
+        raise ValueError("metrics line is not a JSON object")
+    return rec
+
+
+def validate_line(rec: dict) -> list[str]:
+    """Schema errors for one parsed line (empty list = valid)."""
+    errors = []
+    for k in ("step", "time"):
+        if k not in rec:
+            errors.append(f"missing required key {k!r}")
+    if "event" in rec:
+        if rec["event"] not in EVENT_KINDS:
+            errors.append(f"unknown event kind {rec['event']!r}")
+        if "loss" in rec:
+            errors.append("event line must not carry metric field 'loss'")
+    elif "loss" in rec:
+        missing = [k for k in TRAIN_REQUIRED if k not in rec]
+        if missing:
+            errors.append(f"training line missing {missing}")
+    for k, check in FIELD_VALIDATORS.items():
+        if k in rec and not check(rec[k]):
+            errors.append(f"field {k!r} has invalid value {rec[k]!r}")
+    # ema_drift/<group> gauges share the scalar validator
+    for k, v in rec.items():
+        if k.startswith("ema_drift/") and not _num_or_null(v):
+            errors.append(f"field {k!r} has invalid value {v!r}")
+    return errors
+
+
+def validate_lines(lines: Iterable[str]) -> list[str]:
+    """Errors across a whole metrics.jsonl body, tagged with 1-based
+    line numbers. Parse failures (including NaN literals) are schema
+    errors, not exceptions."""
+    errors = []
+    for i, line in enumerate(lines, 1):
+        if not line.strip():
+            continue
+        try:
+            rec = loads_strict(line)
+        except ValueError as e:
+            errors.append(f"line {i}: unparseable: {e}")
+            continue
+        errors.extend(f"line {i}: {e}" for e in validate_line(rec))
+    return errors
+
+
+def validate_file(path: str) -> list[str]:
+    with open(path) as f:
+        return validate_lines(f)
+
+
+def read_metrics(path: str, strict: bool = True) -> list[dict]:
+    """Parsed records of a metrics.jsonl — the loader obs_report builds
+    on. `strict=True` raises on NaN literals / junk lines; with
+    `strict=False` bad lines are skipped (the report of a crashed run
+    must still render — validate_file reports them separately)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            try:
+                out.append(loads_strict(line))
+            except ValueError:
+                if strict:
+                    raise
+    return out
+
+
+def required_train_keys(strict_tracing: bool = False) -> tuple:
+    """The keys every training line must carry (README contract);
+    `strict_tracing` adds the always-present compile counter."""
+    base = TRAIN_REQUIRED + ("t_data", "t_step", "hbm_live_bytes")
+    return base + ("compile_cache_misses",) if strict_tracing else base

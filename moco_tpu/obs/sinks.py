@@ -1,0 +1,359 @@
+"""Pluggable metric sinks + the registry that builds them.
+
+`MetricWriter` (utils/metrics.py) grew into this: the JSONL writer is
+now one sink among several behind a single `write(step, payload)`
+surface. The driver logs once; the fan-out decides where it lands:
+
+- `JsonlSink` — the canonical append-only `metrics.jsonl` (crash-safe
+  per-line flush; the fault counters and chaos harness depend on it, so
+  `build_sinks` always includes it);
+- `CsvSink` — spreadsheet-friendly wide table (header grows as new
+  fields appear; the file is rewritten on header change, cheap at
+  logging cadence);
+- `TensorBoardSink` — optional, only if a TB writer package is
+  importable (the container doesn't bake one in — constructing it
+  without one raises a clear error instead of a deep ImportError);
+- `PrometheusSink` — in-process HTTP endpoint serving the latest
+  gauges in Prometheus text exposition format on `/metrics`, for
+  scraping long runs.
+
+Device-transfer discipline: payloads may contain live `jax.Array`
+metrics. `gather_payload` fetches ALL of them in ONE `jax.device_get`
+call — the old per-field `float(v)` forced one blocking device sync per
+field on every log line (satellite fix; regression-tested by counting
+transfers in tests/test_obs.py).
+"""
+
+from __future__ import annotations
+
+import csv
+import http.server
+import json
+import math
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+
+# Single indirection point for the batched transfer, so tests can count
+# calls without monkeypatching jax itself.
+_DEVICE_GET = jax.device_get
+
+
+def gather_payload(payload: dict) -> dict:
+    """Fetch every device-array value in ONE transfer; host values pass
+    through untouched. Called once per log event, upstream of all sinks."""
+    keys = [k for k, v in payload.items() if isinstance(v, jax.Array)]
+    if not keys:
+        return payload
+    fetched = _DEVICE_GET([payload[k] for k in keys])
+    out = dict(payload)
+    out.update(zip(keys, fetched))
+    return out
+
+
+def _scrub(v):
+    """JSON-safe scalar: non-finite floats -> None (NaN/Inf are invalid
+    strict JSON; the guard writes its own explicit event for non-finite
+    losses), numpy scalars -> python, arrays -> scrubbed lists."""
+    if isinstance(v, np.ndarray):
+        return _scrub(v.item()) if v.ndim == 0 else [_scrub(x) for x in v.tolist()]
+    if isinstance(v, (list, tuple)):
+        return [_scrub(x) for x in v]
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        v = float(v)
+    if isinstance(v, float) and not math.isfinite(v):
+        return None
+    return v
+
+
+def sanitize(rec: dict) -> dict:
+    return {k: _scrub(v) for k, v in rec.items()}
+
+
+class Sink:
+    """Interface: `write` one log event; `fsync` makes the tail durable
+    (preemption/abort paths); `close` is idempotent."""
+
+    def write(self, step: int, payload: dict) -> None:
+        raise NotImplementedError
+
+    def fsync(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class JsonlSink(Sink):
+    """Append-only JSONL metrics (one object per log event).
+
+    Crash-safe tail (fault-tolerance layer): every line is flushed to
+    the OS as written, so a SIGKILL mid-epoch loses at most the line
+    being formatted. `fsync` makes the tail durable across a host crash.
+    Line schema: README "metrics.jsonl line format" / obs/schema.py."""
+
+    def __init__(self, workdir: str, filename: str = "metrics.jsonl"):
+        os.makedirs(workdir, exist_ok=True)
+        self.path = os.path.join(workdir, filename)
+        self._f = open(self.path, "a", buffering=1)
+
+    def write(self, step: int, payload: dict) -> None:
+        rec = {"step": int(step), "time": time.time()}
+        rec.update(sanitize(gather_payload(payload)))
+        self._f.write(json.dumps(rec, allow_nan=False) + "\n")
+        self._f.flush()
+
+    def fsync(self) -> None:
+        if not self._f.closed:
+            self._f.flush()
+            os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self.fsync()
+            self._f.close()
+
+
+class CsvSink(Sink):
+    """Wide-table CSV: one row per log event, columns = union of fields
+    seen so far. A payload introducing new fields triggers a one-shot
+    rewrite with the grown header (rows are kept in memory; at logging
+    cadence — one row per `log_every` steps — this stays tiny). List
+    values are JSON-encoded into their cell."""
+
+    def __init__(self, workdir: str, filename: str = "metrics.csv"):
+        os.makedirs(workdir, exist_ok=True)
+        self.path = os.path.join(workdir, filename)
+        self._fields: list[str] = ["step", "time"]
+        self._rows: list[dict] = []
+
+    def write(self, step: int, payload: dict) -> None:
+        rec = {"step": int(step), "time": time.time()}
+        rec.update(sanitize(gather_payload(payload)))
+        rec = {
+            k: json.dumps(v) if isinstance(v, (list, dict)) else v
+            for k, v in rec.items()
+        }
+        grew = False
+        for k in rec:
+            if k not in self._fields:
+                self._fields.append(k)
+                grew = True
+        self._rows.append(rec)
+        if grew:
+            self._rewrite()
+        else:
+            self._append(rec)
+
+    def _writer(self, f):
+        return csv.DictWriter(f, fieldnames=self._fields, restval="")
+
+    def _rewrite(self) -> None:
+        with open(self.path, "w", newline="") as f:
+            w = self._writer(f)
+            w.writeheader()
+            w.writerows(self._rows)
+
+    def _append(self, rec: dict) -> None:
+        new_file = not os.path.exists(self.path) or os.path.getsize(self.path) == 0
+        with open(self.path, "a", newline="") as f:
+            w = self._writer(f)
+            if new_file:
+                w.writeheader()
+            w.writerow(rec)
+
+    def close(self) -> None:
+        self._rows.clear()
+
+
+class TensorBoardSink(Sink):
+    """Scalar summaries via whichever TB writer is importable
+    (`tensorboardX` or `torch.utils.tensorboard`). The training
+    container deliberately bakes neither in — constructing this sink
+    without one raises a clear RuntimeError naming the fix, instead of
+    an ImportError from three layers down."""
+
+    def __init__(self, workdir: str, subdir: str = "tb"):
+        writer_cls = None
+        try:
+            from tensorboardX import SummaryWriter as writer_cls  # noqa: N813
+        except ImportError:
+            try:
+                from torch.utils.tensorboard import SummaryWriter as writer_cls  # noqa: N813
+            except ImportError:
+                pass
+        if writer_cls is None:
+            raise RuntimeError(
+                "TensorBoardSink needs `tensorboardX` or `torch` installed; "
+                "neither is available in this environment. Use sinks="
+                "'jsonl,csv' (and scripts/obs_report.py) instead, or install one."
+            )
+        self._w = writer_cls(os.path.join(workdir, subdir))
+
+    def write(self, step: int, payload: dict) -> None:
+        rec = sanitize(gather_payload(payload))
+        for k, v in rec.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                self._w.add_scalar(k, v, global_step=int(step))
+
+    def fsync(self) -> None:
+        self._w.flush()
+
+    def close(self) -> None:
+        self._w.close()
+
+
+# -- Prometheus ----------------------------------------------------------
+
+
+def prom_name(key: str, prefix: str = "moco") -> str:
+    """Metric key -> valid Prometheus metric name ([a-zA-Z_:][a-zA-Z0-9_:]*)."""
+    safe = "".join(c if c.isalnum() or c == "_" else "_" for c in key)
+    if safe and safe[0].isdigit():
+        safe = "_" + safe
+    return f"{prefix}_{safe}"
+
+
+class PrometheusSink(Sink):
+    """Last-value gauges + event counters behind an in-process HTTP
+    `/metrics` endpoint (Prometheus text exposition format 0.0.4), for
+    scraping long runs. `port=0` binds an ephemeral port (tests);
+    `self.port` is the bound one. The server runs on a daemon thread and
+    never touches the train loop — `write` only updates a dict under a
+    lock."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1", prefix: str = "moco"):
+        self._lock = threading.Lock()
+        self._gauges: dict[str, float] = {}
+        self._events: dict[str, int] = {}
+        self._prefix = prefix
+        sink = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                if self.path.split("?")[0] != "/metrics":
+                    self.send_error(404)
+                    return
+                body = sink.render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # silence per-request stderr lines
+                pass
+
+        self._server = http.server.ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="prometheus-metrics", daemon=True
+        )
+        self._thread.start()
+
+    def write(self, step: int, payload: dict) -> None:
+        rec = sanitize(gather_payload(payload))
+        with self._lock:
+            self._gauges[prom_name("step", self._prefix)] = int(step)
+            if "event" in rec:
+                self._events[str(rec["event"])] = self._events.get(str(rec["event"]), 0) + 1
+            for k, v in rec.items():
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    continue
+                self._gauges[prom_name(k, self._prefix)] = v
+
+    def render(self) -> str:
+        with self._lock:
+            lines = []
+            for name in sorted(self._gauges):
+                lines.append(f"# TYPE {name} gauge")
+                lines.append(f"{name} {self._gauges[name]}")
+            total = prom_name("events_total", self._prefix)
+            if self._events:
+                lines.append(f"# TYPE {total} counter")
+                for kind in sorted(self._events):
+                    lines.append(f'{total}{{kind="{kind}"}} {self._events[kind]}')
+            return "\n".join(lines) + "\n"
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class MultiSink(Sink):
+    """Fan one log event out to every registered sink. The device fetch
+    happens ONCE here; children receive host values. A failing secondary
+    sink is reported but never kills the run (metrics must not take
+    training down); the primary JSONL sink's errors propagate."""
+
+    def __init__(self, sinks: list[Sink], primary: Optional[JsonlSink] = None):
+        self.sinks = sinks
+        self.primary = primary
+        # driver-facing conveniences (MetricWriter compat)
+        self.path = primary.path if primary is not None else None
+
+    def write(self, step: int, payload: dict) -> None:
+        payload = gather_payload(payload)
+        for s in self.sinks:
+            if s is self.primary:
+                s.write(step, payload)
+                continue
+            try:
+                s.write(step, payload)
+            except Exception as e:
+                print(f"WARNING: metric sink {type(s).__name__} failed: {e!r}", flush=True)
+
+    def fsync(self) -> None:
+        for s in self.sinks:
+            s.fsync()
+
+    def close(self) -> None:
+        for s in self.sinks:
+            s.close()
+
+
+# -- registry ------------------------------------------------------------
+
+SINK_REGISTRY: dict[str, Callable[..., Sink]] = {
+    "jsonl": JsonlSink,
+    "csv": CsvSink,
+    "tensorboard": TensorBoardSink,
+}
+
+
+def register_sink(name: str, factory: Callable[..., Sink]) -> None:
+    """Third-party sinks plug in here; `build_sinks` then accepts the
+    name in its spec string."""
+    SINK_REGISTRY[name] = factory
+
+
+def build_sinks(spec: str, workdir: str, metrics_port: int = 0) -> MultiSink:
+    """`spec` is a comma list of registry names ("jsonl,csv"). The JSONL
+    sink is always included (the fault-tolerance counters, chaos
+    harness, and obs_report all key on metrics.jsonl) and is the
+    MultiSink's primary. `metrics_port > 0` additionally serves
+    Prometheus text format on that port's `/metrics`."""
+    names = [n.strip() for n in (spec or "").split(",") if n.strip()]
+    if "jsonl" not in names:
+        names.insert(0, "jsonl")
+    unknown = [n for n in names if n not in SINK_REGISTRY]
+    if unknown:
+        raise ValueError(
+            f"unknown metric sink(s) {unknown}; registered: {sorted(SINK_REGISTRY)}"
+        )
+    primary: Optional[JsonlSink] = None
+    sinks: list[Sink] = []
+    for n in names:
+        s = SINK_REGISTRY[n](workdir)
+        if n == "jsonl":
+            primary = s  # type: ignore[assignment]
+        sinks.append(s)
+    if metrics_port:
+        sinks.append(PrometheusSink(port=metrics_port))
+    return MultiSink(sinks, primary=primary)

@@ -1,0 +1,118 @@
+"""Step-time breakdown probe + device-memory gauges.
+
+Where does a step's wall time go? Three places the bare loss line can't
+distinguish:
+
+- *host data wait* — the step loop blocked on the prefetch queue
+  (input-bound run);
+- *dispatch* — host-side time to enqueue the jitted step (tracing,
+  argument placement, python overhead);
+- *device compute* — the accelerator actually executing.
+
+Because dispatch is async, `t_dispatch` alone says nothing about device
+time. The probe separates them by calling `jax.block_until_ready` on
+the step's outputs on SAMPLED steps only (`every` steps apart): the
+block drains the device queue, so `t_device` ≈ the device-side tail of
+this step. Off sampled steps the loop stays sync-free — the probe adds
+zero cost to the hot path, same contract as the fault guards.
+
+Device memory comes from `device.memory_stats()` (PjRt): live and peak
+bytes in use. Backends without the API (CPU, some tunnels) return None
+and the metrics line carries `null` — "unknown", never fake zero.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+
+
+class StepTimeProbe:
+    """Per-step timing accumulator for the train loop.
+
+    Usage per iteration:
+        probe.data_wait(seconds)        # host blocked on input
+        probe.dispatched(seconds)       # step_fn call returned (async)
+        if probe.should_sample(step):
+            t0 = time.perf_counter()
+            jax.block_until_ready(outputs)
+            probe.device_block(time.perf_counter() - t0)
+        probe.step_done(total_seconds)
+
+    `payload()` returns the fields for the metrics line: always
+    `t_data`/`t_step`; `t_dispatch`/`t_device` from the most recent
+    sampled step (absent until one happened).
+    """
+
+    def __init__(self, every: int = 0):
+        self.every = int(every)
+        self.t_data = 0.0
+        self.t_step = 0.0
+        self._last_dispatch: Optional[float] = None
+        self._t_dispatch: Optional[float] = None
+        self._t_device: Optional[float] = None
+
+    def should_sample(self, step: int) -> bool:
+        return self.every > 0 and step % self.every == 0
+
+    def data_wait(self, seconds: float) -> None:
+        self.t_data = seconds
+
+    def dispatched(self, seconds: float) -> None:
+        self._last_dispatch = seconds
+
+    def device_block(self, seconds: float) -> None:
+        # a sampled step: the dispatch measured this iteration becomes
+        # the published pair (dispatch, device)
+        self._t_dispatch = self._last_dispatch
+        self._t_device = seconds
+
+    def step_done(self, seconds: float) -> None:
+        self.t_step = seconds
+
+    def payload(self) -> dict:
+        out = {"t_data": self.t_data, "t_step": self.t_step}
+        if self._t_device is not None:
+            out["t_dispatch"] = self._t_dispatch
+            out["t_device"] = self._t_device
+        return out
+
+
+def device_memory_stats(device=None) -> Optional[dict]:
+    """{'hbm_live_bytes', 'hbm_peak_bytes'} for `device` (default: first
+    local device), or None when the backend doesn't expose memory_stats
+    (CPU hosts, some remote tunnels). Key names differ across PjRt
+    versions; both spellings are probed."""
+    if device is None:
+        devices = jax.local_devices()
+        if not devices:
+            return None
+        device = devices[0]
+    try:
+        stats = device.memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    live = stats.get("bytes_in_use", stats.get("bytes_in_use_current"))
+    peak = stats.get("peak_bytes_in_use", stats.get("bytes_in_use_peak"))
+    if live is None and peak is None:
+        return None
+    return {
+        "hbm_live_bytes": int(live) if live is not None else None,
+        "hbm_peak_bytes": int(peak) if peak is not None else None,
+    }
+
+
+def memory_payload() -> dict:
+    """Metrics-line fields for device memory: concrete gauges when the
+    backend reports them, explicit nulls (schema-locked) otherwise."""
+    stats = device_memory_stats()
+    if stats is None:
+        return {"hbm_live_bytes": None, "hbm_peak_bytes": None}
+    return stats
+
+
+__all__ = ["StepTimeProbe", "device_memory_stats", "memory_payload"]

@@ -26,8 +26,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from moco_tpu import obs
 from moco_tpu.core import build_encoder, build_predictor, create_state, make_train_step, place_state
 from moco_tpu.data.pipeline import TwoCropPipeline
+from moco_tpu.obs.sinks import build_sinks
+from moco_tpu.obs.stepstats import StepTimeProbe, memory_payload
 from moco_tpu.parallel import create_mesh, create_multislice_mesh, maybe_initialize_multihost
 from moco_tpu.utils import faults, retry
 from moco_tpu.utils.checkpoint import CheckpointManager
@@ -37,7 +40,13 @@ from moco_tpu.utils.config import (
     config_to_dict,
     resume_compat_diff,
 )
-from moco_tpu.utils.metrics import AverageMeter, MetricWriter, ProgressMeter, profiler_trace
+from moco_tpu.utils.metrics import (
+    AverageMeter,
+    ProfilerWindow,
+    ProgressMeter,
+    print0,
+    profiler_trace,
+)
 from moco_tpu.utils.schedules import build_optimizer, make_lr_schedule
 from moco_tpu.utils.watchdog import StepWatchdog
 
@@ -47,6 +56,7 @@ def train(
     dataset=None,
     profile_dir: Optional[str] = None,
     knn_datasets=None,
+    profile_steps: Optional[tuple] = None,
 ) -> dict:
     """Run the full pretraining loop; returns the last epoch's mean metrics.
 
@@ -54,11 +64,39 @@ def train(
     data of a chosen size this way). `knn_datasets` is an optional
     (bank_dataset, test_dataset) pair for the periodic kNN monitor
     (config.knn_every_epochs); when None it is built from config.data.
+    `profile_steps=(a, b)` captures a jax.profiler trace of exactly
+    global steps [a, b) into `profile_dir` (or `workdir/profile`)
+    instead of the whole-run trace a bare `profile_dir` records.
     """
     # Deterministic fault injection (chaos harness): MOCO_FAULTS installs
     # a fresh plan per run; unset leaves any programmatic plan (tests)
     # alone. Zero-cost when no plan is installed.
     faults.install_from_env()
+    # Telemetry (moco_tpu/obs): the span tracer is installed process-wide
+    # for the run's duration, so the data pipeline's decode spans, the
+    # checkpoint I/O spans, and the kNN-eval spans all land in one trace.
+    # Spans stream to trace_events.jsonl (crash-safe tail) and export as
+    # a Chrome trace (workdir/trace.json, Perfetto-viewable) on exit.
+    tracer = obs.Tracer(os.path.join(config.workdir, "trace_events.jsonl"))
+    prev_tracer = obs.set_tracer(tracer)
+    try:
+        return _train_impl(config, dataset, profile_dir, knn_datasets, profile_steps)
+    finally:
+        try:
+            tracer.export_chrome(os.path.join(config.workdir, "trace.json"))
+        except Exception as e:  # telemetry must never mask the real error
+            print(f"WARNING: chrome trace export failed: {e!r}", flush=True)
+        obs.set_tracer(prev_tracer)
+        tracer.close()
+
+
+def _train_impl(
+    config: TrainConfig,
+    dataset,
+    profile_dir: Optional[str],
+    knn_datasets,
+    profile_steps: Optional[tuple],
+) -> dict:
     # Multi-host rendezvous before any backend use (the reference's
     # dist.init_process_group; auto-detected from the coordinator env,
     # or forced with MOCO_MULTIHOST=1).
@@ -117,7 +155,7 @@ def train(
         # step restores instead (fault-tolerance layer)
         state, extra = ckpt.restore(state, validate_extra=_check_compat)
         start_epoch = int(extra.get("epoch", 0)) + 1
-        print(f"resumed from epoch {start_epoch - 1} (step {int(state.step)})")
+        print0(f"resumed from epoch {start_epoch - 1} (step {int(state.step)})")
 
     shard_q = config.parallel.num_model > 1 and config.moco.num_negatives > 0
     step_fn = make_train_step(
@@ -166,7 +204,7 @@ def train(
         preempted["count"] += 1
         if signum == signal.SIGINT and preempted["count"] > 1:
             raise KeyboardInterrupt
-        print(f"signal {signum}: checkpointing at the next step, then exiting")
+        print0(f"signal {signum}: checkpointing at the next step, then exiting")
 
     prev_handlers = {}
     for sig in (signal.SIGTERM, signal.SIGINT):
@@ -235,10 +273,26 @@ def train(
             image_size=config.data.image_size,
             mesh=mesh,  # extraction data-parallel over the mesh
         )
-        print(f"Epoch [{epoch}] kNN top-1: {top1:.2f}%")
+        print0(f"Epoch [{epoch}] kNN top-1: {top1:.2f}%")
         return top1
 
-    writer = MetricWriter(config.workdir)
+    # Sink fan-out (obs/sinks.py): metrics.jsonl always (primary), plus
+    # whatever config.sinks names; metrics_port>0 additionally serves
+    # Prometheus text format on /metrics for scraping long runs.
+    writer = build_sinks(config.sinks, config.workdir, metrics_port=config.metrics_port)
+    if config.metrics_port:
+        print0(
+            f"metrics endpoint: http://127.0.0.1:{config.metrics_port}/metrics"
+        )
+    # Step-time breakdown probe + windowed profiler (obs/stepstats.py,
+    # utils/metrics.py): both keyed on the host-side global step counter.
+    probe = StepTimeProbe(config.obs_probe_every)
+    profile_window: Optional[ProfilerWindow] = None
+    if profile_steps is not None:
+        profile_window = ProfilerWindow(
+            profile_dir or os.path.join(config.workdir, "profile"), *profile_steps
+        )
+        profile_dir = None  # windowed capture replaces the whole-run trace
     last_avg: dict = {}
 
     # -- runtime guards (fault-tolerance layer) --------------------------
@@ -300,9 +354,15 @@ def train(
             dump_path=os.path.join(config.workdir, "stall_stacks.txt"),
         ).start()
 
+    # Host-side mirror of the global step (one sync here, none per
+    # step): drives the profiler window, the probe's sampling schedule,
+    # and the log lines — step_fn advances state.step once per dispatch
+    # (even on NaN rollback), so the mirror never drifts.
+    gstep_host = int(state.step)
     try:
         with profiler_trace(profile_dir):
             for epoch in range(start_epoch, config.optim.epochs):
+              with obs.span("epoch", epoch=epoch):
                 batch_time = AverageMeter("Time", ":6.3f")
                 data_time = AverageMeter("Data", ":6.3f")
                 losses = AverageMeter("Loss", ":.4e")
@@ -314,13 +374,33 @@ def train(
                     prefix=f"Epoch: [{epoch}]",
                 )
                 guard["epoch"] = epoch
+                it = iter(pipeline.epoch(epoch))
                 end = time.perf_counter()
                 stop_now = False
-                for i, batch in enumerate(pipeline.epoch(epoch)):
-                    if i >= steps_per_epoch:
+                for i in range(steps_per_epoch):
+                    if profile_window is not None:
+                        profile_window.on_step(gstep_host)
+                    fetch0 = time.perf_counter()
+                    with obs.span("data_wait", step=gstep_host):
+                        batch = next(it, None)
+                    if batch is None:
                         break
-                    data_time.update(time.perf_counter() - end)
-                    state, metrics = step_fn(state, batch, root_rng)
+                    t_data = time.perf_counter() - fetch0
+                    data_time.update(t_data)
+                    probe.data_wait(t_data)
+                    t_disp0 = time.perf_counter()
+                    with obs.span("step", step=gstep_host):
+                        state, metrics = step_fn(state, batch, root_rng)
+                    probe.dispatched(time.perf_counter() - t_disp0)
+                    if probe.should_sample(gstep_host):
+                        # drain the device queue ON SAMPLED STEPS ONLY,
+                        # splitting host dispatch from device compute —
+                        # every other step stays sync-free
+                        with obs.span("device_wait", step=gstep_host):
+                            t_dev0 = time.perf_counter()
+                            jax.block_until_ready((state, metrics))
+                        probe.device_block(time.perf_counter() - t_dev0)
+                    gstep_host += 1
                     if wd is not None:
                         wd.beat()  # a timestamp assignment — no device sync
                     if preempted["count"]:
@@ -329,9 +409,16 @@ def train(
                     if i % config.log_every == 0 or i == steps_per_epoch - 1:
                         # host sync only on log steps — keeps the device
                         # queue full; ALL runtime guards piggyback on this
-                        # fetch (zero extra sync in the step loop)
-                        m = {k: float(v) for k, v in metrics.items()}
-                        gstep = int(state.step)
+                        # fetch. ONE batched device_get for the whole
+                        # metrics tree: the old per-field float() forced a
+                        # blocking transfer per metric (obs satellite fix,
+                        # transfer-counted in tests/test_obs.py).
+                        fetched = jax.device_get(metrics)
+                        m = {
+                            k: (float(v) if getattr(v, "ndim", 1) == 0 else v)
+                            for k, v in fetched.items()
+                        }
+                        gstep = gstep_host
                         if faults.enabled():  # chaos harness hooks
                             m["loss"] = faults.corrupt_loss(m["loss"], gstep)
                             faults.maybe_stall(gstep)
@@ -350,7 +437,7 @@ def train(
                                  "nan_steps": guard["nan_steps"]},
                             )
                             writer.fsync()
-                            print(
+                            print0(
                                 f"WARNING: non-finite loss at step {gstep} "
                                 f"({guard['nan_steps']}/{config.nan_guard_threshold})"
                                 " — update skipped",
@@ -372,12 +459,21 @@ def train(
                             losses.update(m["loss"], bs)
                             top1.update(m["acc1"], bs)
                             top5.update(m["acc5"], bs)
-                            batch_time.update(time.perf_counter() - end)
+                            t_step = time.perf_counter() - end
+                            batch_time.update(t_step)
+                            probe.step_done(t_step)
                             progress.display(i)
                             payload = {
                                 "epoch": epoch,
                                 "lr": float(lr_schedule(gstep - 1)),
                                 **m,
+                                # step-time breakdown + device memory
+                                # (obs): t_data/t_step always; dispatch/
+                                # device split from the latest sampled
+                                # step; hbm gauges null where the backend
+                                # lacks memory_stats (CPU hosts)
+                                **probe.payload(),
+                                **memory_payload(),
                             }
                             # fault-tolerance observability: only present
                             # when nonzero, so clean runs keep clean lines
@@ -450,12 +546,14 @@ def train(
                 if stop_now:
                     ckpt.wait()  # the preemption save must be durable before exit
                     writer.fsync()  # ...and so must the metrics tail
-                    print(
+                    print0(
                         f"preempted mid-epoch {epoch}: state saved at step "
                         f"{int(state.step)}; resume will redo epoch {epoch}"
                     )
                     break
     finally:
+        if profile_window is not None:
+            profile_window.close()  # stop a still-open capture window
         if wd is not None:
             wd.stop()
         writer.close()

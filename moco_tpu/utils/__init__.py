@@ -15,7 +15,16 @@ from moco_tpu.utils.checkpoint import (
     restore_best,
     save_best,
 )
-from moco_tpu.utils.metrics import AverageMeter, MetricWriter, ProgressMeter, profiler_trace
+from moco_tpu.utils.metrics import (
+    AverageMeter,
+    MetricWriter,
+    ProfilerWindow,
+    ProgressMeter,
+    is_primary,
+    parse_profile_steps,
+    print0,
+    profiler_trace,
+)
 from moco_tpu.utils.watchdog import StepWatchdog
 
 __all__ = [
@@ -26,7 +35,11 @@ __all__ = [
     "AverageMeter",
     "CheckpointManager",
     "MetricWriter",
+    "ProfilerWindow",
     "ProgressMeter",
+    "is_primary",
+    "parse_profile_steps",
+    "print0",
     "profiler_trace",
     "restore_best",
     "save_best",

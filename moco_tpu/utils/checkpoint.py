@@ -35,6 +35,7 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from moco_tpu.obs.trace import span as obs_span
 from moco_tpu.utils import faults, retry
 
 
@@ -95,7 +96,10 @@ class CheckpointManager:
             if not self.async_save:
                 self._mgr.wait_until_finished()
 
-        retry.retry_call(_save, site="ckpt.save")
+        # span duration = what the TRAIN LOOP paid for this save (with
+        # async_save that is the host snapshot, not the background write)
+        with obs_span("checkpoint_save", step=step, asynchronous=self.async_save):
+            retry.retry_call(_save, site="ckpt.save")
         if faults.enabled():  # chaos harness: corrupt this write on request
             faults.on_checkpoint_saved(
                 self.directory, step, wait=self._mgr.wait_until_finished
@@ -227,12 +231,13 @@ class CheckpointManager:
             if validate_extra is not None:
                 validate_extra(extra)  # incompatibility propagates, no quarantine
             try:
-                restored = retry.retry_call(
-                    self._mgr.restore,
-                    s,
-                    args=ocp.args.Composite(state=ocp.args.StandardRestore(abstract)),
-                    site="ckpt.restore",
-                )
+                with obs_span("checkpoint_restore", step=s):
+                    restored = retry.retry_call(
+                        self._mgr.restore,
+                        s,
+                        args=ocp.args.Composite(state=ocp.args.StandardRestore(abstract)),
+                        site="ckpt.restore",
+                    )
             except Exception as e:
                 if explicit:
                     raise

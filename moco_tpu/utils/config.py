@@ -240,6 +240,26 @@ class TrainConfig:
     # variants); a compile-cache miss after this aborts under
     # --strict-tracing.
     recompile_warmup_steps: int = 8
+    # -- telemetry (moco_tpu/obs) ---------------------------------------
+    # Metric sinks, comma list from the obs sink registry ("jsonl",
+    # "csv", "tensorboard"); the JSONL sink is always included — the
+    # fault counters, chaos harness, and obs_report key on it.
+    sinks: str = "jsonl"
+    # Serve Prometheus text format on http://127.0.0.1:<port>/metrics
+    # (in-process daemon thread; scraping long runs). 0 = off.
+    metrics_port: int = 0
+    # MoCo health gauges computed INSIDE the jitted step (EMA drift,
+    # InfoNCE logit stats, collapse detection, queue staleness —
+    # obs/health.py) and returned through the metrics dict. Cheap
+    # reductions (one extra pass over params for the drift norm), but a
+    # lever exists for steps where every byte counts.
+    health_metrics: bool = True
+    # Step-time breakdown probe: every N steps, block_until_ready the
+    # step's outputs to split host dispatch from device compute
+    # (t_dispatch/t_device on the next log line). Off the hot path
+    # otherwise; 0 disables sampling (t_data/t_step still logged from
+    # host timers, which cost nothing).
+    obs_probe_every: int = 50
 
 
 def config_to_dict(cfg: TrainConfig) -> dict:
@@ -279,6 +299,7 @@ def config_from_dict(d: dict) -> TrainConfig:
                 "checkpoint_async", "checkpoint_keep", "steps_per_epoch",
                 "nan_guard_threshold", "watchdog_timeout",
                 "strict_tracing", "recompile_warmup_steps",
+                "sinks", "metrics_port", "health_metrics", "obs_probe_every",
             )
             if k in d
         },

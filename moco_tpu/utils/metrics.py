@@ -3,21 +3,43 @@
 Reference: `AverageMeter` / `ProgressMeter` (`main_moco.py:~L322-360`)
 print `Epoch: [e][i/n] Time ... Data ... Loss ... Acc@1 ... Acc@5 ...`
 every `--print-freq` steps; non-master ranks are silenced
-(`main_moco.py:~L145`). There is no structured logging in the reference
-(SURVEY.md §5.5) — the JSONL writer and `jax.profiler` hook here are the
-TPU-native observability upgrade (§5.1).
+(`main_moco.py:~L145`). Structured logging lives in `moco_tpu.obs`
+(span tracer, sink registry, step-time probe, health gauges) — this
+module keeps the reference-shaped console surface plus back-compat
+aliases: `MetricWriter` IS the obs JSONL sink (refactored out in the
+telemetry PR; same constructor, same crash-safe flush contract).
+
+Multi-host semantics (reference behavior): only process 0 prints
+console lines; every process keeps writing its own JSONL/sinks —
+per-host metrics matter (a sick host shows up in ITS file), stdout
+interleaving from N hosts does not.
 """
 
 from __future__ import annotations
 
 import contextlib
-import json
-import math
-import os
-import time
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
+
+from moco_tpu.obs.sinks import JsonlSink
+
+
+def is_primary() -> bool:
+    """True on the process that owns console output (process 0; always
+    True single-host). Tolerates being called before any backend/
+    distributed init."""
+    try:
+        return jax.process_index() == 0
+    except Exception:
+        return True
+
+
+def print0(*args, **kwargs) -> None:
+    """`print` on process 0 only — the reference's non-master silencing
+    (`main_moco.py:~L145`) for the driver's informational lines."""
+    if is_primary():
+        print(*args, **kwargs)
 
 
 class AverageMeter:
@@ -46,7 +68,11 @@ class AverageMeter:
 
 
 class ProgressMeter:
-    """`Epoch: [e][ i/n] <meters>` lines, as `main_moco.py:~L340-360`."""
+    """`Epoch: [e][ i/n] <meters>` lines, as `main_moco.py:~L340-360`.
+
+    `display` prints on process 0 only (reference: non-master ranks are
+    silenced, `main_moco.py:~L145`) but always returns the formatted
+    line, so per-process callers/tests can still observe it."""
 
     def __init__(self, num_batches: int, meters: list[AverageMeter], prefix: str = ""):
         num_digits = len(str(num_batches))
@@ -58,72 +84,114 @@ class ProgressMeter:
         entries = [self.prefix + self.batch_fmtstr.format(batch)]
         entries += [str(m) for m in self.meters]
         line = "\t".join(entries)
-        print(line, flush=True)
+        if is_primary():
+            print(line, flush=True)
         return line
 
 
-class MetricWriter:
-    """Append-only JSONL metrics (one object per log event) + stdout.
+class MetricWriter(JsonlSink):
+    """Back-compat name for the JSONL sink (see obs/sinks.py): the
+    original single-destination writer grew into the sink registry; this
+    alias keeps the constructor signature and crash-safe flush contract
+    every existing call site (and the chaos harness) relies on."""
 
-    Crash-safe tail (fault-tolerance layer): every line is flushed to
-    the OS as it is written, so a SIGKILL mid-epoch loses at most the
-    line being formatted — the retry/guard counters that land here are
-    precisely the events one needs to post-mortem a killed run. `fsync`
-    makes the tail durable across a host crash; the train driver calls
-    it at preemption/stall/abort, and `close` always does.
 
-    Line schema (see README "metrics.jsonl line format"): `step`/`time`
-    always; training lines add `epoch`/`lr`/`loss`/`acc1`/`acc5`;
-    fault counters `nan_steps`/`decode_failures`/`io_retries` appear
-    only when nonzero; `compile_cache_misses` appears on every line
-    under `--strict-tracing` (dashboards watch it for flatness); event
-    lines carry `event` ("nonfinite_loss" | "stall" |
-    "recompile_after_warmup") instead of the metric fields."""
+# -- jax.profiler management ---------------------------------------------
+#
+# `jax.profiler.start_trace` is process-global and refuses to start
+# while a trace is active. A naive context manager has two failure
+# modes: (a) nested/overlapping regions crash the outer one, and (b) a
+# region that died between start and stop (exception in user code that
+# skipped the finally, or a prior library leaving a trace running)
+# poisons every LATER region — start_trace raises forever and the run
+# loses profiling. The bookkeeping below makes regions reentrant
+# (inner region = no-op) and start-failure self-healing (stop the
+# dangler, retry once).
 
-    def __init__(self, workdir: str, filename: str = "metrics.jsonl"):
-        os.makedirs(workdir, exist_ok=True)
-        self.path = os.path.join(workdir, filename)
-        self._f = open(self.path, "a", buffering=1)
+_profiler_state = {"active": False}
 
-    def write(self, step: int, payload: dict) -> None:
-        rec = {"step": int(step), "time": time.time()}
-        rec.update(
-            {
-                k: (float(v) if hasattr(v, "__float__") else v)
-                for k, v in payload.items()
-            }
-        )
-        # NaN/Inf are not valid JSON (json.dumps would emit a literal a
-        # strict reader rejects); a non-finite metric becomes null — the
-        # guard writes its own explicit event for non-finite losses.
-        rec = {
-            k: (None if isinstance(v, float) and not math.isfinite(v) else v)
-            for k, v in rec.items()
-        }
-        self._f.write(json.dumps(rec, allow_nan=False) + "\n")
-        self._f.flush()
 
-    def fsync(self) -> None:
-        """Force the written tail to disk (preemption/abort paths)."""
-        if not self._f.closed:
-            self._f.flush()
-            os.fsync(self._f.fileno())
+def _start_profiler(logdir: str) -> bool:
+    """Start a trace; returns True when THIS call owns the stop. A
+    dangling trace from a previous failed region is stopped and the
+    start retried once."""
+    if _profiler_state["active"]:
+        return False  # reentrant region: outer owns the trace
+    try:
+        jax.profiler.start_trace(logdir)
+    except Exception:
+        # a trace someone else started and never stopped — clear it and
+        # retry once; a second failure is a real error and propagates
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        jax.profiler.start_trace(logdir)
+    _profiler_state["active"] = True
+    return True
 
-    def close(self) -> None:
-        if not self._f.closed:
-            self.fsync()
-            self._f.close()
+
+def _stop_profiler() -> None:
+    _profiler_state["active"] = False
+    jax.profiler.stop_trace()
 
 
 @contextlib.contextmanager
 def profiler_trace(logdir: Optional[str]):
-    """`jax.profiler` trace (TensorBoard-viewable) around a code region;
-    no-op when logdir is None."""
+    """`jax.profiler` trace (TensorBoard/Perfetto-viewable) around a
+    code region; no-op when logdir is None; reentrancy-safe (an inner
+    region under an active one is a no-op rather than a crash)."""
     if not logdir:
         yield
         return
-    jax.profiler.start_trace(logdir)
+    owns = _start_profiler(logdir)
     try:
         yield
     finally:
-        jax.profiler.stop_trace()
+        if owns:
+            _stop_profiler()
+
+
+class ProfilerWindow:
+    """Windowed `--profile-steps a:b` capture: trace exactly global
+    steps [a, b) instead of the whole run. Whole-run traces of long
+    jobs are gigabytes of mostly-identical steps; a window placed after
+    warmup is what one actually loads into Perfetto. Drive with
+    `on_step(gstep)` once per loop iteration; `close()` stops a
+    still-open window (early exit, preemption)."""
+
+    def __init__(self, logdir: str, start_step: int, end_step: int):
+        if end_step <= start_step:
+            raise ValueError(f"empty profile window [{start_step}, {end_step})")
+        self.logdir = logdir
+        self.start_step = int(start_step)
+        self.end_step = int(end_step)
+        self._owns = False
+        self._done = False
+
+    def on_step(self, gstep: int) -> None:
+        """Called with the step about to run; starts/stops the window."""
+        if self._done:
+            return
+        if not self._owns and self.start_step <= gstep < self.end_step:
+            self._owns = _start_profiler(self.logdir)
+        elif self._owns and gstep >= self.end_step:
+            self.close()
+
+    def close(self) -> None:
+        if self._owns:
+            self._owns = False
+            _stop_profiler()
+        self._done = True
+
+
+def parse_profile_steps(spec: str) -> Tuple[int, int]:
+    """`"a:b"` -> (a, b) with validation (CLI surface for ProfilerWindow)."""
+    try:
+        a, b = spec.split(":")
+        lo, hi = int(a), int(b)
+    except ValueError:
+        raise ValueError(f"--profile-steps wants 'a:b' (global steps), got {spec!r}")
+    if hi <= lo or lo < 0:
+        raise ValueError(f"--profile-steps window [{lo}, {hi}) is empty or negative")
+    return lo, hi

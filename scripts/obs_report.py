@@ -1,0 +1,242 @@
+#!/usr/bin/env python
+"""Post-run telemetry report: one readable summary from a run's JSONL.
+
+    python scripts/obs_report.py WORKDIR            # or a metrics.jsonl path
+    python scripts/obs_report.py WORKDIR --output report.md
+    python scripts/obs_report.py WORKDIR --strict   # exit 1 on schema errors
+
+Renders, from `metrics.jsonl` (+ `trace.json` when present):
+
+- run shape: steps/epochs covered, wall time, logging cadence;
+- step-time breakdown: where the average step went (data wait vs
+  dispatch vs device compute), as an ASCII "pie";
+- training-health trends: loss/accuracy, EMA drift, InfoNCE pos/neg
+  logit margin, feature-collapse gauges, queue staleness — first→last
+  with min/max, so a drifting gauge is visible without plotting;
+- device memory: peak HBM seen (or "not reported by backend");
+- fault ledger: NaN steps, decode failures, per-site I/O retries,
+  compile-cache misses, and every event line verbatim;
+- trace summary: total/self time by span name from the Chrome trace.
+
+Needs only the stdlib + moco_tpu.obs.schema (no jax import, so it runs
+on any machine the JSONL was copied to). CI's obs-smoke step runs this
+against the driver smoke's artifacts on every PR, so report rendering
+cannot rot.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# allow running from a checkout without installation
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from moco_tpu.obs import schema  # noqa: E402
+
+
+BAR_WIDTH = 36
+
+
+def _bar(frac: float, width: int = BAR_WIDTH) -> str:
+    n = max(0, min(width, round(frac * width)))
+    return "#" * n + "." * (width - n)
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "null"
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v)
+
+
+def _trend(lines: list[dict], key: str) -> str | None:
+    vals = [(r["step"], r[key]) for r in lines if isinstance(r.get(key), (int, float))]
+    if not vals:
+        return None
+    nums = [v for _, v in vals]
+    first, last = vals[0][1], vals[-1][1]
+    return (
+        f"{_fmt(first)} -> {_fmt(last)}"
+        f"  (min {_fmt(min(nums))}, max {_fmt(max(nums))}, n={len(nums)})"
+    )
+
+
+def render_report(metrics_path: str, trace_path: str | None = None) -> str:
+    records = schema.read_metrics(metrics_path, strict=False)
+    train_lines = [r for r in records if "loss" in r and "event" not in r]
+    events = [r for r in records if "event" in r]
+    out: list[str] = []
+    w = out.append
+
+    w("# Telemetry report")
+    w("")
+    w(f"source: `{metrics_path}` — {len(records)} lines "
+      f"({len(train_lines)} training, {len(events)} events)")
+    if not records:
+        w("")
+        w("(empty metrics file — nothing to report)")
+        return "\n".join(out)
+    steps = [r["step"] for r in records]
+    wall = records[-1]["time"] - records[0]["time"]
+    epochs = sorted({r["epoch"] for r in records if "epoch" in r})
+    w(f"steps {min(steps)}..{max(steps)}"
+      + (f", epochs {epochs[0]}..{epochs[-1]}" if epochs else "")
+      + f", {wall:.1f}s of wall time between first and last line")
+    w("")
+
+    # -- step-time breakdown --------------------------------------------
+    w("## Step-time breakdown")
+    w("")
+    t_data = [r["t_data"] for r in train_lines if isinstance(r.get("t_data"), (int, float))]
+    t_step = [r["t_step"] for r in train_lines if isinstance(r.get("t_step"), (int, float))]
+    if t_step:
+        mean_step = sum(t_step) / len(t_step)
+        mean_data = sum(t_data) / len(t_data) if t_data else 0.0
+        other = max(mean_step - mean_data, 0.0)
+        w(f"mean logged step: {mean_step * 1e3:.1f} ms")
+        for name, sec in (("data wait", mean_data), ("dispatch+device", other)):
+            frac = sec / mean_step if mean_step else 0.0
+            w(f"  {name:<16} {_bar(frac)} {frac * 100:5.1f}%  ({sec * 1e3:.1f} ms)")
+        disp = [r["t_dispatch"] for r in train_lines
+                if isinstance(r.get("t_dispatch"), (int, float))]
+        dev = [r["t_device"] for r in train_lines
+               if isinstance(r.get("t_device"), (int, float))]
+        if dev:
+            w(f"  probe samples: dispatch {sum(disp) / len(disp) * 1e3:.1f} ms, "
+              f"device {sum(dev) / len(dev) * 1e3:.1f} ms "
+              f"(block_until_ready on {len(dev)} sampled lines)")
+    else:
+        w("(no t_step fields — run predates the telemetry layer?)")
+    w("")
+
+    # -- device memory ---------------------------------------------------
+    w("## Device memory")
+    w("")
+    hbm = [r["hbm_peak_bytes"] for r in train_lines
+           if isinstance(r.get("hbm_peak_bytes"), (int, float))]
+    live = [r["hbm_live_bytes"] for r in train_lines
+            if isinstance(r.get("hbm_live_bytes"), (int, float))]
+    if hbm or live:
+        if hbm:
+            w(f"peak HBM: {max(hbm) / 2**30:.2f} GiB")
+        if live:
+            w(f"live bytes, last line: {live[-1] / 2**30:.2f} GiB")
+    else:
+        w("not reported by backend (hbm gauges are null — CPU host or "
+          "tunnel without memory_stats)")
+    w("")
+
+    # -- health trends ---------------------------------------------------
+    w("## Training health (first -> last)")
+    w("")
+    for key in (
+        "loss", "acc1", "acc5", "lr", "knn_top1",
+        "ema_drift", "logit_pos_mean", "logit_neg_mean",
+        "logit_pos_std", "logit_neg_std",
+        "feature_std", "feature_dim_active",
+        "queue_age_mean", "queue_age_max",
+    ):
+        # knn_top1 rides aux lines, not train lines
+        src = records if key == "knn_top1" else train_lines
+        t = _trend(src, key)
+        if t is not None:
+            w(f"- `{key}`: {t}")
+    groups = sorted(
+        {k for r in train_lines for k in r if k.startswith("ema_drift/")}
+    )
+    for g in groups:
+        t = _trend(train_lines, g)
+        if t is not None:
+            w(f"- `{g}`: {t}")
+    pos = _trend(train_lines, "logit_pos_mean")
+    if pos is None:
+        w("- (no health gauges on these lines — --no-health-metrics run?)")
+    w("")
+
+    # -- fault ledger ----------------------------------------------------
+    w("## Fault ledger")
+    w("")
+    ledger = []
+    nan = [r["nan_steps"] for r in records if "nan_steps" in r]
+    if nan:
+        ledger.append(f"- non-finite loss steps: {max(nan)}")
+    dec = [r["decode_failures"] for r in records if "decode_failures" in r]
+    if dec:
+        ledger.append(f"- decode failures (cumulative): {max(dec)}")
+    io: dict[str, int] = {}
+    for r in records:
+        for site, n in (r.get("io_retries") or {}).items():
+            io[site] = max(io.get(site, 0), n)
+    if io:
+        ledger.append(f"- io retries by site: {io}")
+    ccm = [r["compile_cache_misses"] for r in records if "compile_cache_misses" in r]
+    if ccm:
+        flat = " (flat after warmup)" if len(set(ccm[1:])) <= 1 else " (STILL RISING)"
+        ledger.append(f"- compile cache misses: last={ccm[-1]}{flat}")
+    for e in events:
+        ledger.append(f"- event @ step {e['step']}: {e['event']}")
+    w("\n".join(ledger) if ledger else "clean run — no faults, no events.")
+    w("")
+
+    # -- trace summary ---------------------------------------------------
+    if trace_path and os.path.exists(trace_path):
+        w("## Trace summary (Chrome trace; open in ui.perfetto.dev)")
+        w("")
+        with open(trace_path) as f:
+            trace = json.load(f)
+        totals: dict[str, tuple[float, int]] = {}
+        for ev in trace.get("traceEvents", []):
+            if ev.get("ph") != "X":
+                continue
+            t, n = totals.get(ev["name"], (0.0, 0))
+            totals[ev["name"]] = (t + ev.get("dur", 0.0), n + 1)
+        for name, (dur, n) in sorted(totals.items(), key=lambda kv: -kv[1][0])[:12]:
+            w(f"- `{name}`: {dur / 1e6:.2f}s total over {n} spans")
+        w("")
+    return "\n".join(out)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("source", help="run workdir, or a metrics.jsonl path")
+    ap.add_argument("--trace", default=None, help="chrome trace json (default: <workdir>/trace.json)")
+    ap.add_argument("--output", "-o", default=None, help="write the report here (default: stdout)")
+    ap.add_argument(
+        "--strict", action="store_true",
+        help="validate every line against the schema; exit 1 on violations",
+    )
+    args = ap.parse_args()
+
+    metrics_path = args.source
+    trace_path = args.trace
+    if os.path.isdir(metrics_path):
+        if trace_path is None:
+            cand = os.path.join(metrics_path, "trace.json")
+            trace_path = cand if os.path.exists(cand) else None
+        metrics_path = os.path.join(metrics_path, "metrics.jsonl")
+    if not os.path.exists(metrics_path):
+        print(f"error: {metrics_path} not found", file=sys.stderr)
+        return 2
+
+    errors = schema.validate_file(metrics_path)
+    report = render_report(metrics_path, trace_path)
+    if errors:
+        report += "\n## Schema violations\n\n" + "\n".join(f"- {e}" for e in errors) + "\n"
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(report + "\n")
+        print(f"wrote {args.output}")
+    else:
+        print(report)
+    if errors:
+        print(f"{len(errors)} schema violation(s)", file=sys.stderr)
+        return 1 if args.strict else 0
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -50,7 +50,8 @@ def _run_steps(fused: bool, n_steps: int = 2):
         ims = jax.random.normal(jax.random.PRNGKey(10 + i), (2, 8, 16, 16, 3))
         batch = shard_batch(mesh, {"im_q": ims[0], "im_k": ims[1]})
         state, metrics = step(state, batch, rng)
-        metrics_hist.append({k: float(v) for k, v in metrics.items()})
+        # metrics now carry non-scalar health gauges too (queue_age_hist)
+        metrics_hist.append({k: np.asarray(v) for k, v in metrics.items()})
     return state, metrics_hist
 
 
@@ -63,6 +64,10 @@ def test_fused_step_matches_dense_step():
         np.testing.assert_allclose(mf["loss"], md["loss"], rtol=1e-5)
         np.testing.assert_allclose(mf["acc1"], md["acc1"], atol=1e-6)
         np.testing.assert_allclose(mf["acc5"], md["acc5"], atol=1e-6)
+        # the health gauges are path-independent by construction (same
+        # q/k/queue inputs on both sides) — they must agree too
+        np.testing.assert_allclose(mf["logit_pos_mean"], md["logit_pos_mean"], rtol=1e-5)
+        np.testing.assert_allclose(mf["queue_age_hist"], md["queue_age_hist"], atol=0)
     for a, b in zip(jax.tree.leaves(state_f.params_q), jax.tree.leaves(state_d.params_q)):
         # Tolerances calibrated to fp32 reassociation, not kernel bugs:
         # the fused kernel and the dense path reduce the queue axis in

@@ -180,6 +180,36 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--print-freq", "-p", type=int, default=None)
     p.add_argument("--steps-per-epoch", type=int, default=None, help="override (smoke tests)")
     p.add_argument("--profile-dir", default=None, help="jax.profiler trace output dir")
+    # telemetry (moco_tpu/obs)
+    p.add_argument(
+        "--profile-steps", default=None, metavar="A:B",
+        help="capture the jax.profiler trace for global steps [A, B) only "
+        "(into --profile-dir or workdir/profile) instead of the whole run; "
+        "intended for real-chip runs (jax's CPU backend can deadlock on "
+        "mid-run profiler starts)",
+    )
+    p.add_argument(
+        "--sinks", default=None,
+        help="comma list of metric sinks (jsonl,csv,tensorboard); the "
+        "JSONL sink is always included",
+    )
+    p.add_argument(
+        "--metrics-port", type=int, default=None,
+        help="serve Prometheus text format on http://127.0.0.1:PORT/metrics "
+        "(0 = off) for scraping long runs",
+    )
+    p.add_argument(
+        "--obs-probe-every", type=int, default=None,
+        help="step-time breakdown probe: every N steps block_until_ready "
+        "the step to split host dispatch from device compute "
+        "(t_dispatch/t_device on metric lines; 0 disables sampling)",
+    )
+    p.add_argument(
+        "--no-health-metrics", dest="health_metrics", action="store_false",
+        default=None,
+        help="disable the in-step MoCo health gauges (EMA drift, logit "
+        "stats, collapse detection, queue staleness)",
+    )
     return p
 
 
@@ -252,6 +282,10 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         nan_guard_threshold=args.nan_guard_threshold,
         strict_tracing=args.strict_tracing,
         recompile_warmup_steps=args.recompile_warmup,
+        sinks=args.sinks,
+        metrics_port=args.metrics_port,
+        health_metrics=args.health_metrics,
+        obs_probe_every=args.obs_probe_every,
     )
 
 
@@ -266,9 +300,14 @@ def main() -> None:
 
         faults.install(args.faults)
     config = config_from_args(args)
+    profile_steps = None
+    if args.profile_steps:
+        from moco_tpu.utils.metrics import parse_profile_steps
+
+        profile_steps = parse_profile_steps(args.profile_steps)
     from moco_tpu.train import train
 
-    result = train(config, profile_dir=args.profile_dir)
+    result = train(config, profile_dir=args.profile_dir, profile_steps=profile_steps)
     print(f"done: {result}")
 
 
