@@ -302,6 +302,60 @@ def main() -> None:
     imgs_per_sec = batch * steps / dt
     per_chip = imgs_per_sec / n_dev
 
+    # ---- obs overhead (the cost of the telemetry layer itself) --------
+    # Same step count twice: FULL obs (in-step health gauges + installed
+    # span tracer + a JSONL sink write at logging cadence) vs BARE
+    # (--no-health-metrics equivalent, sinks disabled, no tracer). The
+    # headline `value` above stays the untouched steady-state loop,
+    # comparable with prior BENCH_r*.json rounds; this field tracks what
+    # observability costs so a regression in the telemetry layer is a
+    # visible number, not a silent throughput tax.
+    obs_overhead_pct = None
+    if not os.environ.get("BENCH_SKIP_OBS_OVERHEAD"):
+        try:
+            import dataclasses as _dc
+            import tempfile as _tf
+
+            from moco_tpu import obs as _obs
+            from moco_tpu.obs.sinks import JsonlSink
+
+            def _timed_leg(step_fn, sink=None, tracer=None):
+                st = state
+                prev = _obs.set_tracer(tracer)
+                try:
+                    for _ in range(2):  # warm this variant's compile
+                        st, m = step_fn(st, batch_dict, root_rng)
+                    float(m["loss"])
+                    t0 = time.perf_counter()
+                    for i in range(steps):
+                        with _obs.span("step", step=i):
+                            st, m = step_fn(st, batch_dict, root_rng)
+                        if sink is not None and i % 10 == 0:
+                            sink.write(i, m)
+                    float(m["loss"])
+                    return time.perf_counter() - t0
+                finally:
+                    _obs.set_tracer(prev)
+
+            sink = JsonlSink(_tf.mkdtemp(prefix="bench_obs_"))
+            dt_full = _timed_leg(step, sink=sink, tracer=_obs.Tracer())
+            sink.close()
+            step_bare = make_train_step(
+                _dc.replace(config, health_metrics=False),
+                encoder, tx, mesh, donate=False, predictor=predictor,
+                total_steps=5004 * config.optim.epochs,
+            )
+            dt_bare = _timed_leg(step_bare)
+            if dt_bare > 0:
+                obs_overhead_pct = round((dt_full - dt_bare) / dt_bare * 100.0, 2)
+            print(
+                f"obs overhead: full={dt_full:.2f}s bare={dt_bare:.2f}s "
+                f"-> {obs_overhead_pct}%",
+                file=sys.stderr,
+            )
+        except Exception as e:
+            print(f"obs-overhead bench failed: {e}", file=sys.stderr)
+
     # ---- MFU (per-device FLOPs over per-device peak) ------------------
     flops_per_dev = _step_flops(step, state, batch_dict, root_rng) or (
         None if is_vit else _analytic_step_flops(batch, img) / n_dev
@@ -403,6 +457,9 @@ def main() -> None:
                 "with_data_imgs_per_sec_per_chip": None
                 if with_data is None
                 else round(with_data, 2),
+                # telemetry-layer cost: full obs (health gauges + tracer
+                # + sink writes) vs bare, same compiled shapes
+                "obs_overhead_pct": obs_overhead_pct,
             }
         )
     )

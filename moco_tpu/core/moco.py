@@ -37,6 +37,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from moco_tpu.core.ema import ema_update
 from moco_tpu.core.queue import check_queue_divisibility, enqueue, init_queue
+from moco_tpu.obs import comms
 from moco_tpu.obs import health as obs_health
 from moco_tpu.models import ProjectionHead, V3MLPHead, create_resnet
 from moco_tpu.ops.losses import cross_entropy, infonce_logits, l2_normalize, topk_accuracy
@@ -460,8 +461,9 @@ def make_train_step(
         k_cat, stats_k = apply_encoder(params_k, state.batch_stats_k, x_cat)
         k1, k2 = jnp.split(lax.stop_gradient(l2_normalize(k_cat)), 2, axis=0)
         if n_data > 1:
-            k1_g = lax.all_gather(k1, DATA_AXIS).reshape(-1, cfg.dim)
-            k2_g = lax.all_gather(k2, DATA_AXIS).reshape(-1, cfg.dim)
+            with comms.tag("v3.key_gather", "all_gather", (k1, k2), n_data):
+                k1_g = lax.all_gather(k1, DATA_AXIS).reshape(-1, cfg.dim)
+                k2_g = lax.all_gather(k2, DATA_AXIS).reshape(-1, cfg.dim)
             rank = lax.axis_index(DATA_AXIS)
         else:
             k1_g, k2_g, rank = k1, k2, 0
@@ -495,9 +497,12 @@ def make_train_step(
             # — psum over the sequence (model) axis restores the full
             # gradient. Head/predictor grads are replicated-identical
             # (they consume the psum-pooled feature) and stay untouched.
-            grads["enc"]["backbone"] = lax.psum(
-                grads["enc"]["backbone"], MODEL_AXIS
-            )
+            with comms.tag(
+                "grad.seq_psum", "psum", grads["enc"]["backbone"], n_model
+            ):
+                grads["enc"]["backbone"] = lax.psum(
+                    grads["enc"]["backbone"], MODEL_AXIS
+                )
         metrics = {"loss": loss, **topk_accuracy(logits, labels)}
         metrics = lax.pmean(metrics, DATA_AXIS)
         stats_q = lax.pmean(stats_q, DATA_AXIS)
@@ -521,7 +526,8 @@ def make_train_step(
             if frozen_pe is not None:
                 new_trainable["enc"]["backbone"]["patch_embed"] = frozen_pe
         else:
-            grads = lax.pmean(grads, DATA_AXIS)
+            with comms.tag("grad.psum", "psum", grads, n_data):
+                grads = lax.pmean(grads, DATA_AXIS)
             updates, opt_state = tx.update(grads, state.opt_state, trainable)
             if cfg.freeze_patch_embed and "patch_embed" in updates["enc"].get("backbone", {}):
                 # zeroed grads are not enough: AdamW's decoupled weight decay
@@ -586,7 +592,8 @@ def make_train_step(
             # the unshuffle must regenerate the SAME permutation as the
             # shuffle above, so reusing step_rng is the contract, not a bug
             k_local = balanced_unshuffle(step_rng, k_sh, DATA_AXIS)  # mocolint: disable=JX003
-            k_global = lax.all_gather(k_local, DATA_AXIS).reshape(-1, cfg.dim)
+            with comms.tag("queue.enqueue_gather", "all_gather", k_local, n_data):
+                k_global = lax.all_gather(k_local, DATA_AXIS).reshape(-1, cfg.dim)
         else:  # 'syncbn' (cross-replica BN handles decorrelation) or 'none'
             # key_bn_running_stats (EMAN, config.py rationale): the key
             # forward runs EVAL-mode BN against the EMA'd running stats —
@@ -598,11 +605,11 @@ def make_train_step(
                 train=not cfg.key_bn_running_stats,
             )
             k_local = l2_normalize(k_local)
-            k_global = (
-                lax.all_gather(k_local, DATA_AXIS).reshape(-1, cfg.dim)
-                if n_data > 1
-                else k_local
-            )
+            if n_data > 1:
+                with comms.tag("queue.enqueue_gather", "all_gather", k_local, n_data):
+                    k_global = lax.all_gather(k_local, DATA_AXIS).reshape(-1, cfg.dim)
+            else:
+                k_global = k_local
         k_local = lax.stop_gradient(k_local)
         k_global = lax.stop_gradient(k_global)
 
@@ -628,7 +635,8 @@ def make_train_step(
                     # queue rows are sharded over `model`: logits currently
                     # hold [pos | my negative shard]; assemble full rows.
                     l_pos, l_neg = logits[:, :1], logits[:, 1:]
-                    l_neg = lax.all_gather(l_neg, MODEL_AXIS, axis=1, tiled=True)
+                    with comms.tag("queue.logits_gather", "all_gather", l_neg, n_model):
+                        l_neg = lax.all_gather(l_neg, MODEL_AXIS, axis=1, tiled=True)
                     logits = jnp.concatenate([l_pos, l_neg], axis=1)
                 loss = cross_entropy(logits, labels)
                 acc = topk_accuracy(logits, labels)
@@ -687,7 +695,9 @@ def make_train_step(
             params_q = new_trainable["enc"]
         else:
             grad_axes = (DATA_AXIS, MODEL_AXIS) if shard_queue_over_model else DATA_AXIS
-            grads = lax.pmean(grads, grad_axes)
+            grad_world = n_data * (n_model if shard_queue_over_model else 1)
+            with comms.tag("grad.psum", "psum", grads, grad_world):
+                grads = lax.pmean(grads, grad_axes)
             updates, opt_state = tx.update(grads, state.opt_state, trainable)
             params_q = optax.apply_updates(trainable, updates)["enc"]
 

@@ -9,6 +9,9 @@ One cross-cutting subsystem, five parts (see each module's docstring):
 - `sinks`     pluggable metric sinks (JSONL/CSV/TensorBoard/Prometheus
               `/metrics` HTTP endpoint) behind one write() surface
 - `schema`    the machine-checkable metrics.jsonl line contract
+- `fleet`     cross-host stats aggregation + out-of-band heartbeats
+- `comms`     named collective sites + analytic bytes-moved counters
+- `alerts`    declarative in-stream alert rules -> alerts.jsonl
 
 `span`/`instant` are re-exported eagerly because they are the
 high-traffic wiring surface (`from moco_tpu import obs; obs.span(...)`)
@@ -40,6 +43,13 @@ _LAZY = {
     "device_memory_stats": "stepstats",
     "memory_payload": "stepstats",
     "health_summary": "health",
+    # fleet observability (obs/fleet.py — jax) + comms ledger + alerts
+    "FleetAggregator": "fleet",
+    "Heartbeat": "fleet",
+    "read_heartbeats": "fleet",
+    "AlertEngine": "alerts",
+    "FatalAlertError": "alerts",
+    "parse_rules": "alerts",
 }
 
 

@@ -1,0 +1,192 @@
+"""Collective-communication instrumentation — named sites + analytic
+bytes-moved counters.
+
+MoCo's step time on a pod is gated by its synchronous collectives (the
+batch-shuffle all_gather / all_to_all, the queue's key gather, the
+gradient psum, ZeRO's reduce-scatter + param all_gather, ring
+attention's ppermute rotation), yet none of them were measurable: the
+span tracer sees host wall time only, and a jax.profiler capture is a
+gigabyte-scale artifact you don't have for every run.
+
+This module makes each collective site *self-describing* at trace time.
+A site wraps its collective in `comms.tag(...)`:
+
+    with comms.tag("grad.psum", "psum", grads, n_data):
+        grads = lax.pmean(grads, DATA_AXIS)
+
+which does two things, both free at runtime:
+
+- enters a `jax.named_scope` (`comms.<site>`) so the op carries the site
+  name into HLO metadata — device profiles and compiled-module dumps
+  attribute collective time to the training-level site, not an opaque
+  `all-reduce.42`;
+- records the site's ANALYTIC per-device wire cost into a process-level
+  ledger. Shapes and dtypes are static during tracing, so the cost is
+  exact and costs nothing per step — the ledger is written once per
+  trace (idempotent on retrace) and read on log steps.
+
+Cost model (per device, per call; n = axis size, b = operand bytes of
+this device's shard):
+
+    all_gather     b * (n-1)        receives every other shard
+    all_to_all     b * (n-1)/n      keeps 1/n of its own data
+    psum           2b * (n-1)/n     ring all-reduce (reduce-scatter +
+                                    all-gather halves)
+    psum_scatter   b * (n-1)/n      reduce-scatter half only
+    ppermute       b                one neighbor hop per call
+    broadcast      b
+
+These are the standard ring-collective volumes ("How to Scale Your
+Model" §collectives); they are *analytic* counters, not measurements —
+what the ICI must move, independent of link speed.
+
+Surfaced as `comms/<site>` bytes-per-step gauges on every metrics line
+(train driver) and as a per-collective table in `scripts/obs_report.py`.
+
+A site whose axis has size 1 records 0 bytes (no wire traffic) but
+still registers, so the report can show which sites exist.
+
+NOTE (gather_perm shuffle): the queue enqueue reuses the unshuffle
+all_gather (`shuffle.gather_keys`) instead of issuing its own collective
+— one of the rebuild's saved collectives — so `queue.enqueue_gather`
+appears only for the 'a2a' and 'none' shuffle modes, which gather the
+key batch separately.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+
+import jax
+
+COLLECTIVES = (
+    "all_gather",
+    "all_to_all",
+    "psum",
+    "psum_scatter",
+    "ppermute",
+    "broadcast",
+)
+
+
+def tree_bytes(tree) -> int:
+    """Total payload bytes of a pytree of arrays (tracers included —
+    `.size`/`.dtype` are static during tracing)."""
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        size = getattr(leaf, "size", None)
+        dtype = getattr(leaf, "dtype", None)
+        if size is None or dtype is None:
+            continue
+        total += int(size) * jax.numpy.dtype(dtype).itemsize
+    return total
+
+
+def collective_bytes(collective: str, nbytes: int, axis_size: int) -> int:
+    """Per-device wire bytes for ONE call of `collective` on a local
+    operand of `nbytes` over an axis of `axis_size` (see the module
+    docstring's cost model)."""
+    n = int(axis_size)
+    if collective not in COLLECTIVES:
+        raise ValueError(f"unknown collective {collective!r} (known: {COLLECTIVES})")
+    if n <= 1:
+        return 0
+    if collective == "all_gather":
+        return nbytes * (n - 1)
+    if collective == "all_to_all":
+        return (nbytes * (n - 1)) // n
+    if collective == "psum":
+        return (2 * nbytes * (n - 1)) // n
+    if collective == "psum_scatter":
+        return (nbytes * (n - 1)) // n
+    # ppermute / broadcast: the shard moves once
+    return nbytes
+
+
+@dataclasses.dataclass(frozen=True)
+class CommSite:
+    """One annotated collective site, as recorded at trace time."""
+
+    site: str
+    collective: str
+    operand_bytes: int  # this device's shard, one call
+    bytes_per_call: int  # analytic wire cost, one call
+    calls_per_step: int  # e.g. ring ppermute fires n times per step
+    axis_size: int
+
+    @property
+    def bytes_per_step(self) -> int:
+        return self.bytes_per_call * self.calls_per_step
+
+
+_LOCK = threading.Lock()
+_LEDGER: dict[str, CommSite] = {}
+
+
+def tag(
+    site: str,
+    collective: str,
+    operand,
+    axis_size: int,
+    calls_per_step: int = 1,
+):
+    """Record `site`'s analytic cost and return a context manager naming
+    the enclosed ops `comms.<site>` in HLO metadata.
+
+    Call at the collective site, around the collective. Safe inside
+    jit/shard_map tracing: the ledger write keys on the site name and is
+    idempotent across retraces.
+    """
+    nbytes = tree_bytes(operand)
+    rec = CommSite(
+        site=site,
+        collective=collective,
+        operand_bytes=nbytes,
+        bytes_per_call=collective_bytes(collective, nbytes, axis_size),
+        calls_per_step=int(calls_per_step),
+        axis_size=int(axis_size),
+    )
+    with _LOCK:
+        _LEDGER[site] = rec
+    try:
+        return jax.named_scope(f"comms.{site}")
+    except Exception:  # exotic backends without named_scope support
+        return contextlib.nullcontext()
+
+
+def snapshot() -> dict[str, CommSite]:
+    """Current ledger (site -> CommSite), a copy."""
+    with _LOCK:
+        return dict(_LEDGER)
+
+
+def reset() -> None:
+    """Clear the ledger (run start / tests)."""
+    with _LOCK:
+        _LEDGER.clear()
+
+
+def payload() -> dict:
+    """Metrics-line fields: `comms/<site>` per-step wire bytes per
+    device, plus `comms/total` — empty dict when nothing is annotated
+    (clean lines for runs that never traced a collective)."""
+    sites = snapshot()
+    if not sites:
+        return {}
+    out = {f"comms/{name}": rec.bytes_per_step for name, rec in sites.items()}
+    out["comms/total"] = sum(rec.bytes_per_step for rec in sites.values())
+    return out
+
+
+__all__ = [
+    "COLLECTIVES",
+    "CommSite",
+    "collective_bytes",
+    "payload",
+    "reset",
+    "snapshot",
+    "tag",
+    "tree_bytes",
+]

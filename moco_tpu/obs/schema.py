@@ -14,8 +14,15 @@ Line kinds (all carry `step` int + `time` float):
   (`ema_drift*`, `logit_*`, `feature_*`, `queue_age_*`), and the fault
   counters (`nan_steps`/`decode_failures`/`io_retries` when nonzero,
   `compile_cache_misses` under --strict-tracing);
-- *event lines*: `event` in EVENT_KINDS instead of the metric fields;
+- *event lines*: `event` in EVENT_KINDS instead of the metric fields
+  (alert events additionally carry `alert`/`severity` and an
+  `alert/<rule>` Prometheus gauge);
 - *aux lines*: neither (e.g. the periodic `knn_top1` line).
+
+Fleet-observability fields (obs/fleet.py, obs/comms.py) ride training
+lines: `straggler_skew`/`fleet_hosts` plus the
+`fleet/<field>_{min,mean,max,argmax}` family on process 0, and the
+analytic `comms/<site>` bytes-per-step counters on every process.
 
 Numbers are finite or null — NaN/Inf literals are rejected at parse
 time (`loads_strict`), matching the writer's scrubbing.
@@ -29,7 +36,7 @@ import json
 from typing import Any, Iterable
 
 EVENT_KINDS = frozenset(
-    {"nonfinite_loss", "stall", "recompile_after_warmup"}
+    {"nonfinite_loss", "stall", "recompile_after_warmup", "alert"}
 )
 
 TRAIN_REQUIRED = ("epoch", "lr", "loss", "acc1", "acc5")
@@ -95,6 +102,23 @@ FIELD_VALIDATORS = {
     # mocolint runtime arm (present on every line under --strict-tracing)
     "compile_cache_misses": _int_like,
     "watchdog_timeout": _num,
+    # fleet observability (obs/fleet.py; process-0 lines only)
+    "fleet_hosts": _int_like,
+    "straggler_skew": _num_or_null,
+    # alert event lines (obs/alerts.py)
+    "alert": lambda v: isinstance(v, str),
+    "severity": lambda v: v in ("warn", "fatal"),
+}
+
+# key-prefix families sharing one validator: per-layer-group EMA drift,
+# the fleet min/mean/max/argmax gauges (null where no host reports the
+# field), comms bytes counters (analytic, always numeric), and the
+# per-rule Prometheus alert gauges
+PREFIX_VALIDATORS = {
+    "ema_drift/": _num_or_null,
+    "fleet/": _num_or_null,
+    "comms/": _num,
+    "alert/": _num,
 }
 
 
@@ -129,10 +153,12 @@ def validate_line(rec: dict) -> list[str]:
     for k, check in FIELD_VALIDATORS.items():
         if k in rec and not check(rec[k]):
             errors.append(f"field {k!r} has invalid value {rec[k]!r}")
-    # ema_drift/<group> gauges share the scalar validator
+    # prefix families (ema_drift/<group>, fleet/<field>_<stat>,
+    # comms/<site>, alert/<rule>) share per-family validators
     for k, v in rec.items():
-        if k.startswith("ema_drift/") and not _num_or_null(v):
-            errors.append(f"field {k!r} has invalid value {v!r}")
+        for prefix, check in PREFIX_VALIDATORS.items():
+            if k.startswith(prefix) and not check(v):
+                errors.append(f"field {k!r} has invalid value {v!r}")
     return errors
 
 

@@ -233,6 +233,7 @@ class PrometheusSink(Sink):
         self._gauges: dict[str, float] = {}
         self._events: dict[str, int] = {}
         self._prefix = prefix
+        self.host = host
         sink = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -297,6 +298,12 @@ class MultiSink(Sink):
         self.primary = primary
         # driver-facing conveniences (MetricWriter compat)
         self.path = primary.path if primary is not None else None
+        # the Prometheus sink, when present — the driver logs its ACTUAL
+        # bound address (the requested port may be 0 = ephemeral, or
+        # shifted by the process index)
+        self.prometheus: Optional[PrometheusSink] = next(
+            (s for s in sinks if isinstance(s, PrometheusSink)), None
+        )
 
     def write(self, step: int, payload: dict) -> None:
         payload = gather_payload(payload)
@@ -333,12 +340,40 @@ def register_sink(name: str, factory: Callable[..., Sink]) -> None:
     SINK_REGISTRY[name] = factory
 
 
-def build_sinks(spec: str, workdir: str, metrics_port: int = 0) -> MultiSink:
+def per_process_filename(base: str, process_index: int) -> str:
+    """`metrics.jsonl` for process 0 (every single-host consumer keeps
+    its path); `metrics.p<i>.jsonl` for co-hosted processes sharing a
+    workdir, which previously clobbered each other's files.
+    `scripts/obs_report.py` globs and merges the family."""
+    if process_index <= 0:
+        return base
+    stem, _, ext = base.rpartition(".")
+    return f"{stem}.p{process_index}.{ext}" if stem else f"{base}.p{process_index}"
+
+
+def derive_metrics_port(base_port: int, process_index: int) -> int:
+    """Per-process Prometheus port: `base + process_index`, so N
+    processes on one host stop racing for the same bind (satellite fix;
+    0 stays 0 = disabled)."""
+    return base_port + process_index if base_port else 0
+
+
+def build_sinks(
+    spec: str,
+    workdir: str,
+    metrics_port: int = 0,
+    metrics_host: str = "127.0.0.1",
+    process_index: int = 0,
+) -> MultiSink:
     """`spec` is a comma list of registry names ("jsonl,csv"). The JSONL
     sink is always included (the fault-tolerance counters, chaos
     harness, and obs_report all key on metrics.jsonl) and is the
     MultiSink's primary. `metrics_port > 0` additionally serves
-    Prometheus text format on that port's `/metrics`."""
+    Prometheus text format on `metrics_host:(metrics_port +
+    process_index)` — per-process ports so co-hosted processes don't
+    collide, and a bindable host for scrapers that aren't on-box.
+    Process > 0 file sinks write `*.p<i>.*` names (shared-workdir
+    clobber fix)."""
     names = [n.strip() for n in (spec or "").split(",") if n.strip()]
     if "jsonl" not in names:
         names.insert(0, "jsonl")
@@ -347,13 +382,24 @@ def build_sinks(spec: str, workdir: str, metrics_port: int = 0) -> MultiSink:
         raise ValueError(
             f"unknown metric sink(s) {unknown}; registered: {sorted(SINK_REGISTRY)}"
         )
+    default_files = {"jsonl": "metrics.jsonl", "csv": "metrics.csv"}
     primary: Optional[JsonlSink] = None
     sinks: list[Sink] = []
     for n in names:
-        s = SINK_REGISTRY[n](workdir)
+        if n in default_files:
+            s = SINK_REGISTRY[n](
+                workdir, filename=per_process_filename(default_files[n], process_index)
+            )
+        else:
+            s = SINK_REGISTRY[n](workdir)
         if n == "jsonl":
             primary = s  # type: ignore[assignment]
         sinks.append(s)
     if metrics_port:
-        sinks.append(PrometheusSink(port=metrics_port))
+        sinks.append(
+            PrometheusSink(
+                port=derive_metrics_port(metrics_port, process_index),
+                host=metrics_host,
+            )
+        )
     return MultiSink(sinks, primary=primary)

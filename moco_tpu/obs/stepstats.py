@@ -72,6 +72,12 @@ class StepTimeProbe:
     def step_done(self, seconds: float) -> None:
         self.t_step = seconds
 
+    @property
+    def last_dispatch(self) -> Optional[float]:
+        """Most recent host-side dispatch time (every step, not just
+        probe-sampled ones) — the fleet vector's dispatch-lag field."""
+        return self._last_dispatch
+
     def payload(self) -> dict:
         out = {"t_data": self.t_data, "t_step": self.t_step}
         if self._t_device is not None:

@@ -89,12 +89,21 @@ class Tracer:
     Chrome export notes the drop count instead of growing unboundedly.
     """
 
-    def __init__(self, jsonl_path: Optional[str] = None, max_spans: int = 200_000):
+    def __init__(
+        self,
+        jsonl_path: Optional[str] = None,
+        max_spans: int = 200_000,
+        process_index: int = 0,
+    ):
         self._lock = threading.Lock()
         self._local = threading.local()
         self._spans: list[dict] = []
         self._dropped = 0
         self.max_spans = max_spans
+        # multi-process runs tag every span with the process index so
+        # scripts/trace_merge.py can stitch per-host streams into one
+        # Perfetto file with a track per host
+        self.process_index = int(process_index)
         # perf_counter origin so ts starts near 0 (Perfetto-friendly);
         # wall-clock anchor recorded for post-hoc correlation with
         # metrics.jsonl `time` fields.
@@ -125,6 +134,7 @@ class Tracer:
             "tid": threading.get_ident(),
             "thread": threading.current_thread().name,
             "depth": depth,
+            "p": self.process_index,
         }
         if args:
             rec["args"] = args
@@ -151,10 +161,13 @@ class Tracer:
             return list(self._spans)
 
     def export_chrome(self, path: str) -> str:
-        """Write the Chrome trace-event JSON; returns `path`."""
-        events = spans_to_chrome_events(self.snapshot(), pid=os.getpid())
+        """Write the Chrome trace-event JSON; returns `path`. pid is the
+        PROCESS INDEX (not the OS pid), so merged multi-process traces
+        get one track group per host."""
+        events = spans_to_chrome_events(self.snapshot(), pid=self.process_index)
         meta = {
             "wall_t0": self.wall_t0,
+            "process_index": self.process_index,
             "dropped_spans": self._dropped,
         }
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
@@ -171,11 +184,28 @@ class Tracer:
             self._f.close()
 
 
-def spans_to_chrome_events(spans: list[dict], pid: int = 0) -> list[dict]:
+def spans_to_chrome_events(
+    spans: list[dict],
+    pid: int = 0,
+    process_name: Optional[str] = None,
+    ts_offset_us: float = 0.0,
+) -> list[dict]:
     """Span records -> Chrome trace-event list (`ph:"X"` complete events
-    plus thread-name metadata). Shared by the live tracer and
-    `scripts/obs_report.py`'s rebuild-from-JSONL path."""
+    plus thread-name metadata). Shared by the live tracer,
+    `scripts/obs_report.py`'s rebuild-from-JSONL path, and
+    `scripts/trace_merge.py` (which passes a per-host `ts_offset_us`
+    clock correction and a `process_name` track label)."""
     events: list[dict] = []
+    if process_name is not None:
+        events.append(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": 0,
+                "args": {"name": process_name},
+            }
+        )
     thread_names: dict[int, str] = {}
     for s in spans:
         tid = s.get("tid", 0)
@@ -183,7 +213,7 @@ def spans_to_chrome_events(spans: list[dict], pid: int = 0) -> list[dict]:
         ev = {
             "name": s["name"],
             "ph": "X",
-            "ts": s["ts"],
+            "ts": s["ts"] + ts_offset_us,
             "dur": s.get("dur", 0),
             "pid": pid,
             "tid": tid,

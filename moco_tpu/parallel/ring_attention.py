@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from moco_tpu.obs import comms
 from moco_tpu.ops.flash_attention import flash_attention_with_lse
 from moco_tpu.parallel.compat import axis_size
 
@@ -78,5 +79,8 @@ def ring_attention(
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
         return num, m_new, den, k_nxt, v_nxt
 
-    num, m, den, _, _ = jax.lax.fori_loop(0, n, body, (num0, m0, den0, k, v))
+    # the ring rotates the K/V shards n times per call (the fori_loop
+    # body traces once but executes n ppermute hops)
+    with comms.tag("ring_attention.kv_ppermute", "ppermute", (k, v), n, calls_per_step=n):
+        num, m, den, _, _ = jax.lax.fori_loop(0, n, body, (num0, m0, den0, k, v))
     return (num / den[..., None]).astype(q.dtype)

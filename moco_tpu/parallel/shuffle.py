@@ -40,12 +40,16 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from moco_tpu.obs import comms
 from moco_tpu.parallel.compat import axis_size
 
 
-def _merge_gather(x: jax.Array, axis_name: str) -> jax.Array:
-    """all_gather with the device dim folded into the batch dim: (N_global, ...)."""
-    g = lax.all_gather(x, axis_name)  # (n_dev, B_local, ...)
+def _merge_gather(x: jax.Array, axis_name: str, site: str) -> jax.Array:
+    """all_gather with the device dim folded into the batch dim:
+    (N_global, ...). `site` names the collective in the comms ledger +
+    HLO metadata (obs/comms.py)."""
+    with comms.tag(site, "all_gather", x, axis_size(axis_name)):
+        g = lax.all_gather(x, axis_name)  # (n_dev, B_local, ...)
     return g.reshape((-1,) + g.shape[2:])
 
 
@@ -62,7 +66,7 @@ def shuffle_gather(x: jax.Array, perm: jax.Array, axis_name: str) -> jax.Array:
     """Give this device the rows `perm[rank*B:(rank+1)*B]` of the global batch."""
     local_b = x.shape[0]
     rank = lax.axis_index(axis_name)
-    x_all = _merge_gather(x, axis_name)
+    x_all = _merge_gather(x, axis_name, "shuffle.gather_images")
     my_rows = lax.dynamic_slice_in_dim(perm, rank * local_b, local_b)
     return jnp.take(x_all, my_rows, axis=0)
 
@@ -79,7 +83,9 @@ def unshuffle_gather(
     """
     local_b = k.shape[0]
     rank = lax.axis_index(axis_name)
-    k_all = _merge_gather(k, axis_name)  # rows in perm order
+    # this gather is ALSO the queue's key source (the enqueue reuses
+    # k_global, saving the reference's third all_gather)
+    k_all = _merge_gather(k, axis_name, "shuffle.gather_keys")  # rows in perm order
     k_global = jnp.take(k_all, inv_perm, axis=0)  # original order
     k_local = lax.dynamic_slice_in_dim(k_global, rank * local_b, local_b)
     return k_local, k_global
@@ -106,7 +112,8 @@ def balanced_shuffle(rng: jax.Array, x: jax.Array, axis_name: str) -> jax.Array:
         raise ValueError(f"a2a shuffle needs local batch {b} divisible by axis size {n}")
     pre, post = _local_perms(rng, b, axis_name)
     x = jnp.take(x, pre, axis=0)
-    x = lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0, tiled=True)
+    with comms.tag("shuffle.a2a", "all_to_all", x, n):
+        x = lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0, tiled=True)
     return jnp.take(x, post, axis=0)
 
 
@@ -117,5 +124,6 @@ def balanced_unshuffle(rng: jax.Array, y: jax.Array, axis_name: str) -> jax.Arra
     b = y.shape[0]
     pre, post = _local_perms(rng, b, axis_name)
     y = jnp.take(y, jnp.argsort(post), axis=0)
-    y = lax.all_to_all(y, axis_name, split_axis=0, concat_axis=0, tiled=True)
+    with comms.tag("shuffle.a2a_unshuffle", "all_to_all", y, n):
+        y = lax.all_to_all(y, axis_name, split_axis=0, concat_axis=0, tiled=True)
     return jnp.take(y, jnp.argsort(pre), axis=0)

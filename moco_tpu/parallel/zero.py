@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from moco_tpu.obs import comms
 from moco_tpu.parallel.compat import axis_size
 from moco_tpu.parallel.mesh import DATA_AXIS
 
@@ -86,11 +87,14 @@ def sharded_update(tx, grads, opt_state, trainable, axis_name: str = DATA_AXIS):
     new_opt_state_local_expanded). Call inside shard_map; `grads` are the
     LOCAL (pre-reduction) gradients, `trainable` the replicated params,
     `opt_state` the local (1, m)/scalar view of the sharded state."""
-    grad_sh = jax.tree.map(lambda g: scatter_mean(g, axis_name), grads)
+    n = axis_size(axis_name)
+    with comms.tag("zero.grad_reduce_scatter", "psum_scatter", grads, n):
+        grad_sh = jax.tree.map(lambda g: scatter_mean(g, axis_name), grads)
     param_sh = jax.tree.map(lambda p: local_shard(p, axis_name), trainable)
     updates, new_opt = tx.update(grad_sh, squeeze_opt_state(opt_state), param_sh)
     new_param_sh = jax.tree.map(lambda p, u: p + u, param_sh, updates)
-    new_trainable = jax.tree.map(
-        lambda s, p: unshard(s, p, axis_name), new_param_sh, trainable
-    )
+    with comms.tag("zero.params_all_gather", "all_gather", new_param_sh, n):
+        new_trainable = jax.tree.map(
+            lambda s, p: unshard(s, p, axis_name), new_param_sh, trainable
+        )
     return new_trainable, expand_opt_state(new_opt)

@@ -29,7 +29,10 @@ import numpy as np
 from moco_tpu import obs
 from moco_tpu.core import build_encoder, build_predictor, create_state, make_train_step, place_state
 from moco_tpu.data.pipeline import TwoCropPipeline
-from moco_tpu.obs.sinks import build_sinks
+from moco_tpu.obs import comms
+from moco_tpu.obs.alerts import AlertEngine, FatalAlertError, parse_rules
+from moco_tpu.obs.fleet import FleetAggregator, Heartbeat
+from moco_tpu.obs.sinks import build_sinks, per_process_filename
 from moco_tpu.obs.stepstats import StepTimeProbe, memory_payload
 from moco_tpu.parallel import create_mesh, create_multislice_mesh, maybe_initialize_multihost
 from moco_tpu.utils import faults, retry
@@ -72,18 +75,34 @@ def train(
     # a fresh plan per run; unset leaves any programmatic plan (tests)
     # alone. Zero-cost when no plan is installed.
     faults.install_from_env()
+    # Multi-host rendezvous BEFORE the first backend query (the
+    # reference's dist.init_process_group; auto-detected from the
+    # coordinator env, or forced with MOCO_MULTIHOST=1) — the tracer
+    # below needs the process index, and reading it any earlier would
+    # initialize a single-process backend.
+    maybe_initialize_multihost()
+    pidx = jax.process_index()
     # Telemetry (moco_tpu/obs): the span tracer is installed process-wide
     # for the run's duration, so the data pipeline's decode spans, the
     # checkpoint I/O spans, and the kNN-eval spans all land in one trace.
-    # Spans stream to trace_events.jsonl (crash-safe tail) and export as
-    # a Chrome trace (workdir/trace.json, Perfetto-viewable) on exit.
-    tracer = obs.Tracer(os.path.join(config.workdir, "trace_events.jsonl"))
+    # Spans stream to trace_events.jsonl (crash-safe tail; per-process
+    # filenames when processes share a workdir — scripts/trace_merge.py
+    # stitches them into one Perfetto file with a track per host) and
+    # export as a Chrome trace on exit.
+    tracer = obs.Tracer(
+        os.path.join(
+            config.workdir, per_process_filename("trace_events.jsonl", pidx)
+        ),
+        process_index=pidx,
+    )
     prev_tracer = obs.set_tracer(tracer)
     try:
         return _train_impl(config, dataset, profile_dir, knn_datasets, profile_steps)
     finally:
         try:
-            tracer.export_chrome(os.path.join(config.workdir, "trace.json"))
+            tracer.export_chrome(
+                os.path.join(config.workdir, per_process_filename("trace.json", pidx))
+            )
         except Exception as e:  # telemetry must never mask the real error
             print(f"WARNING: chrome trace export failed: {e!r}", flush=True)
         obs.set_tracer(prev_tracer)
@@ -97,9 +116,9 @@ def _train_impl(
     knn_datasets,
     profile_steps: Optional[tuple],
 ) -> dict:
-    # Multi-host rendezvous before any backend use (the reference's
-    # dist.init_process_group; auto-detected from the coordinator env,
-    # or forced with MOCO_MULTIHOST=1).
+    # (the multi-host rendezvous already ran in train(), before the
+    # tracer needed the process index; this is a no-op then, and keeps
+    # direct _train_impl callers working)
     maybe_initialize_multihost()
     if config.parallel.num_data is None:
         # slice-aware layout: on multi-slice deployments the data axis
@@ -276,14 +295,92 @@ def _train_impl(
         print0(f"Epoch [{epoch}] kNN top-1: {top1:.2f}%")
         return top1
 
-    # Sink fan-out (obs/sinks.py): metrics.jsonl always (primary), plus
+    # Sink fan-out (obs/sinks.py): metrics.jsonl always (primary; file
+    # sinks get per-process names when processes share a workdir), plus
     # whatever config.sinks names; metrics_port>0 additionally serves
-    # Prometheus text format on /metrics for scraping long runs.
-    writer = build_sinks(config.sinks, config.workdir, metrics_port=config.metrics_port)
-    if config.metrics_port:
-        print0(
-            f"metrics endpoint: http://127.0.0.1:{config.metrics_port}/metrics"
+    # Prometheus text format on /metrics for scraping long runs (port
+    # shifted by the process index so co-hosted processes don't collide).
+    pidx = jax.process_index()
+    writer = build_sinks(
+        config.sinks,
+        config.workdir,
+        metrics_port=config.metrics_port,
+        metrics_host=config.metrics_host,
+        process_index=pidx,
+    )
+    if writer.prometheus is not None:
+        # the ACTUAL bound address (derived port, configured host), not
+        # the requested one — what a scraper must be pointed at
+        print(
+            f"[p{pidx}] metrics endpoint: "
+            f"http://{writer.prometheus.host}:{writer.prometheus.port}/metrics",
+            flush=True,
         )
+    # Fleet observability (obs/fleet.py): per-host stats vector gathered
+    # across processes on log steps (jitted all_gather over a one-device-
+    # per-host mesh); process 0's lines carry the fleet reduction. The
+    # heartbeat file is the out-of-band liveness signal obs_report and
+    # trace_merge fall back to when a host dies mid-run. The comms
+    # ledger is reset here so this run's metrics reflect this run's
+    # traced collectives only.
+    comms.reset()
+    fleet = FleetAggregator() if config.fleet_metrics else None
+    heartbeat = Heartbeat(
+        config.workdir, process_index=pidx,
+        trace_wall_t0=getattr(obs.get_tracer(), "wall_t0", None),
+    )
+    heartbeat.beat(step=int(state.step), epoch=start_epoch)
+    # Alerting engine (obs/alerts.py): declarative rules evaluated
+    # against every logged payload; fired alerts land in alerts.jsonl +
+    # an in-band event line (Prometheus per-rule gauge rides it).
+    engine = (
+        AlertEngine(
+            parse_rules(config.alert_rules),
+            workdir=config.workdir,
+            process_index=pidx,
+        )
+        if config.alert_rules and config.alert_rules != "none"
+        else None
+    )
+
+    def handle_alerts(gstep: int, epoch: int, fired: list) -> None:
+        """Write in-band alert event lines; under --alerts-fatal, make
+        an emergency checkpoint durable and abort."""
+        if not fired:
+            return
+        for a in fired:
+            print0(
+                f"ALERT [{a['severity']}] {a['rule']} @ step {gstep}: {a['message']}",
+                flush=True,
+            )
+            writer.write(
+                gstep,
+                {"epoch": epoch, "event": "alert", "alert": a["rule"],
+                 "severity": a["severity"], f"alert/{a['rule']}": 1},
+            )
+        writer.fsync()
+        if config.alerts_fatal:
+            # emergency checkpoint of the last known-finite state (the
+            # fault-tolerance layer's save-first-die-second path)
+            s = guard["good_state"]
+            if int(s.step) not in ckpt.all_steps():
+                ckpt.save(
+                    int(s.step), s,
+                    extra={
+                        "epoch": epoch - 1,  # mid-epoch semantics (see watchdog)
+                        "config": config_to_dict(config),
+                        "num_data": num_data,
+                        "emergency": True,
+                        "alert": fired[0]["rule"],
+                    },
+                    force=True,
+                )
+                ckpt.wait()
+            raise FatalAlertError(
+                f"aborting on fired alert(s) {[a['rule'] for a in fired]} at step "
+                f"{gstep} (--alerts-fatal); emergency checkpoint saved — see "
+                f"{engine.path} and {writer.path}"
+            )
     # Step-time breakdown probe + windowed profiler (obs/stepstats.py,
     # utils/metrics.py): both keyed on the host-side global step counter.
     probe = StepTimeProbe(config.obs_probe_every)
@@ -437,6 +534,15 @@ def _train_impl(
                                  "nan_steps": guard["nan_steps"]},
                             )
                             writer.fsync()
+                            if engine is not None:
+                                handle_alerts(
+                                    gstep, epoch,
+                                    engine.observe(
+                                        gstep,
+                                        {"event": "nonfinite_loss",
+                                         "nan_steps": guard["nan_steps"]},
+                                    ),
+                                )
                             print0(
                                 f"WARNING: non-finite loss at step {gstep} "
                                 f"({guard['nan_steps']}/{config.nan_guard_threshold})"
@@ -492,7 +598,36 @@ def _train_impl(
                                 # FLATNESS, and absence would read as 0
                                 misses = compile_monitor.misses()
                                 payload["compile_cache_misses"] = misses
+                            # comms ledger: analytic per-step wire bytes
+                            # for every collective the step traced
+                            # (obs/comms.py) — static values, no syncs
+                            payload.update(comms.payload())
+                            if fleet is not None:
+                                # cross-host aggregation: EVERY process
+                                # contributes its vector (this is a
+                                # collective, keyed on the replicated
+                                # log schedule so all hosts agree);
+                                # process 0's line carries the fleet view
+                                stats = fleet.gather(
+                                    fleet.host_vector(
+                                        t_data=payload.get("t_data"),
+                                        t_step=payload.get("t_step"),
+                                        dispatch_lag=probe.last_dispatch,
+                                        io_retries=float(
+                                            sum(io_retries.values())
+                                        ) if io_retries else 0.0,
+                                        decode_failures=float(decode_failures),
+                                        hbm_live=payload.get("hbm_live_bytes"),
+                                    )
+                                )
+                                if fleet.process_index == 0:
+                                    payload.update(fleet.payload(stats))
+                            heartbeat.beat(step=gstep, epoch=epoch)
                             writer.write(gstep, payload)
+                            if engine is not None:
+                                handle_alerts(
+                                    gstep, epoch, engine.observe(gstep, payload)
+                                )
                             if recompile_guard is not None:
                                 diagnosis = recompile_guard.update(gstep, misses)
                                 if diagnosis is not None:
@@ -556,6 +691,8 @@ def _train_impl(
             profile_window.close()  # stop a still-open capture window
         if wd is not None:
             wd.stop()
+        if engine is not None:
+            engine.close()
         writer.close()
         ckpt.close()
         for sig, h in prev_handlers.items():

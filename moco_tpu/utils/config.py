@@ -245,9 +245,15 @@ class TrainConfig:
     # "csv", "tensorboard"); the JSONL sink is always included — the
     # fault counters, chaos harness, and obs_report key on it.
     sinks: str = "jsonl"
-    # Serve Prometheus text format on http://127.0.0.1:<port>/metrics
-    # (in-process daemon thread; scraping long runs). 0 = off.
+    # Serve Prometheus text format on http://<metrics_host>:<port +
+    # process_index>/metrics (in-process daemon thread; scraping long
+    # runs). 0 = off. The per-process port shift keeps co-hosted
+    # processes from colliding on one bind.
     metrics_port: int = 0
+    # Bind address for the Prometheus endpoint; "0.0.0.0" exposes it to
+    # off-box scrapers (the old hardcoded loopback made pod-wide
+    # scraping impossible).
+    metrics_host: str = "127.0.0.1"
     # MoCo health gauges computed INSIDE the jitted step (EMA drift,
     # InfoNCE logit stats, collapse detection, queue staleness —
     # obs/health.py) and returned through the metrics dict. Cheap
@@ -260,6 +266,25 @@ class TrainConfig:
     # otherwise; 0 disables sampling (t_data/t_step still logged from
     # host timers, which cost nothing).
     obs_probe_every: int = 50
+    # -- fleet observability (obs/fleet.py, obs/alerts.py) --------------
+    # Cross-host aggregation: on log steps every process contributes a
+    # fixed-width stats vector (t_data/t_step/dispatch lag/io retries/
+    # decode failures/live HBM) to a jitted all_gather; process 0's
+    # metrics lines then carry fleet min/mean/max/argmax per field and
+    # the straggler_skew gauge, and every process writes an out-of-band
+    # heartbeat file (merged by obs_report when a host dies mid-run).
+    fleet_metrics: bool = True
+    # Declarative alert rules evaluated in-stream against every logged
+    # payload (obs/alerts.py grammar): "default" = the built-in set
+    # (step-time spike, data starvation, straggler skew, EMA runaway,
+    # queue staleness, non-finite loss, stall, heartbeat loss);
+    # "default,<spec>" extends it; "none" disables. Fired alerts land in
+    # workdir/alerts.jsonl + an `event: "alert"` metrics line (which the
+    # Prometheus sink exposes as a per-rule gauge).
+    alert_rules: str = "default"
+    # Abort on any fired alert, after an emergency checkpoint (reuses
+    # the fault-tolerance layer's save-first-die-second path).
+    alerts_fatal: bool = False
 
 
 def config_to_dict(cfg: TrainConfig) -> dict:
@@ -299,7 +324,8 @@ def config_from_dict(d: dict) -> TrainConfig:
                 "checkpoint_async", "checkpoint_keep", "steps_per_epoch",
                 "nan_guard_threshold", "watchdog_timeout",
                 "strict_tracing", "recompile_warmup_steps",
-                "sinks", "metrics_port", "health_metrics", "obs_probe_every",
+                "sinks", "metrics_port", "metrics_host", "health_metrics",
+                "obs_probe_every", "fleet_metrics", "alert_rules", "alerts_fatal",
             )
             if k in d
         },

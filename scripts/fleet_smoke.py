@@ -1,0 +1,220 @@
+#!/usr/bin/env python
+"""Fleet-observability smoke: a tiny 8-virtual-device training run that
+must produce the full fleet surface, asserted hard.
+
+    python scripts/fleet_smoke.py [--workdir DIR]
+
+(The script pins an 8-virtual-device CPU platform itself, so it runs
+identically in CI and on a dev box.)
+
+Asserts (the ISSUE-4 acceptance bullet, executable):
+
+1. every process-0 training line in `metrics.jsonl` carries the fleet
+   reduction — `straggler_skew`, `fleet_hosts`, and the
+   `fleet/<field>_{min,mean,max,argmax}` family;
+2. the comms ledger surfaced NON-ZERO `comms/*` analytic byte counters
+   for the shuffle, queue-enqueue, and gradient collectives (8-way data
+   axis, a2a shuffle);
+3. a deterministically injected fault (`nan@step=N`, utils/faults.py)
+   fired an alert: `alerts.jsonl` has a `nonfinite_loss` entry and the
+   metrics stream has the matching `event: "alert"` line;
+4. `scripts/trace_merge.py` builds a single merged Perfetto trace with
+   one track (pid) per process and the heartbeat clock anchor applied;
+5. `scripts/obs_report.py --strict` validates every line, fleet fields
+   included, and renders the fleet/comms/alerts sections.
+
+CI runs this in the tier-1 job and uploads alerts.jsonl + the merged
+trace as artifacts. Wall cost: one tiny compile + 4 steps.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+# 8 virtual CPU devices, pinned BEFORE jax initializes (same trick as
+# tests/conftest.py) — the fleet/comms surface needs a real multi-device
+# data axis even though this is one process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+NAN_STEP = 3  # global step whose observed loss is corrupted to NaN
+
+
+def run_smoke(workdir: str) -> dict:
+    """Run the tiny driver run; returns {'workdir', 'result'}. Split
+    from the assertions so tests can reuse the run."""
+    from moco_tpu.data.datasets import SyntheticDataset
+    from moco_tpu.train import train
+    from moco_tpu.utils import faults
+    from moco_tpu.utils.config import (
+        DataConfig,
+        MocoConfig,
+        OptimConfig,
+        TrainConfig,
+    )
+
+    config = TrainConfig(
+        moco=MocoConfig(
+            arch="resnet18",
+            dim=16,
+            num_negatives=128,
+            temperature=0.2,
+            mlp=True,
+            # balanced all_to_all shuffle: exercises the a2a comms site
+            # AND the separate queue-enqueue all_gather (gather_perm
+            # folds the queue gather into the unshuffle)
+            shuffle="a2a",
+            cifar_stem=True,
+            compute_dtype="float32",
+        ),
+        optim=OptimConfig(lr=0.03, epochs=1, cos=True),
+        data=DataConfig(dataset="synthetic", image_size=16, global_batch=64, num_workers=2),
+        workdir=workdir,
+        log_every=1,
+        obs_probe_every=2,
+        sinks="jsonl",
+        fleet_metrics=True,
+        alert_rules="default",
+    )
+    # deterministic fault: the loss observed at NAN_STEP becomes NaN —
+    # the non-finite guard skips the update and the alert engine's
+    # `nonfinite_loss` event rule must fire
+    faults.install(f"nan@step={NAN_STEP}")
+    try:
+        dataset = SyntheticDataset(num_examples=4 * 64, image_size=16)  # 4 steps of 64
+        result = train(config, dataset=dataset)
+    finally:
+        faults.clear()
+    return {"workdir": workdir, "result": result}
+
+
+def _read_jsonl(path):
+    with open(path) as f:
+        return [json.loads(l) for l in f if l.strip()]
+
+
+def assert_surface(workdir: str) -> None:
+    from moco_tpu.obs import schema
+
+    # -- 1. fleet fields on every process-0 training line ---------------
+    metrics_path = os.path.join(workdir, "metrics.jsonl")
+    records = schema.read_metrics(metrics_path)
+    train_lines = [r for r in records if "loss" in r and "event" not in r]
+    assert len(train_lines) == 3, (
+        f"expected 3 training lines (4 steps, one NaN-skipped), got {len(train_lines)}"
+    )
+    fleet_required = (
+        "straggler_skew", "fleet_hosts",
+        "fleet/t_step_min", "fleet/t_step_mean", "fleet/t_step_max",
+        "fleet/t_step_argmax", "fleet/t_data_mean", "fleet/io_retries_max",
+    )
+    for rec in train_lines:
+        missing = [k for k in fleet_required if k not in rec]
+        assert not missing, f"training line {rec['step']} missing fleet fields {missing}"
+        assert rec["fleet_hosts"] == 1  # single process, 8 devices
+        assert rec["straggler_skew"] is not None and rec["straggler_skew"] >= 0
+        # one host: min == mean == max for a reported field
+        assert rec["fleet/t_step_min"] == rec["fleet/t_step_max"]
+
+    # -- 2. non-zero comms counters for shuffle/queue/grad --------------
+    last = train_lines[-1]
+    for site in ("comms/shuffle.a2a", "comms/queue.enqueue_gather", "comms/grad.psum"):
+        assert last.get(site, 0) > 0, f"{site} missing or zero: {last.get(site)!r}"
+    assert last["comms/total"] >= sum(
+        v for k, v in last.items()
+        if k.startswith("comms/") and k != "comms/total"
+    ) / 2  # sanity: total aggregates the sites
+
+    # -- 3. injected NaN -> nonfinite event -> fired alert --------------
+    events = {r["event"] for r in records if "event" in r}
+    assert "nonfinite_loss" in events, f"no nonfinite_loss event (events: {events})"
+    assert "alert" in events, f"no alert event line (events: {events})"
+    alerts = _read_jsonl(os.path.join(workdir, "alerts.jsonl"))
+    assert any(a["rule"] == "nonfinite_loss" for a in alerts), (
+        f"alerts.jsonl has no nonfinite_loss alert: {alerts}"
+    )
+
+    # -- heartbeat: out-of-band liveness file ---------------------------
+    hb_path = os.path.join(workdir, "heartbeat.p0.json")
+    assert os.path.exists(hb_path), "process 0 wrote no heartbeat file"
+    hb = json.load(open(hb_path))
+    assert hb["process"] == 0 and hb["step"] >= NAN_STEP
+    assert "trace_wall_t0" in hb, "heartbeat missing the trace clock anchor"
+
+
+def assert_merged_trace(workdir: str) -> str:
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "trace_merge", os.path.join(os.path.dirname(os.path.abspath(__file__)), "trace_merge.py")
+    )
+    tm = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tm)
+    merged_path = os.path.join(workdir, "merged_trace.json")
+    summary = tm.merge_traces(workdir, merged_path)
+    assert summary["processes"], "trace_merge found no span streams"
+    trace = json.load(open(merged_path))
+    pids = {e["pid"] for e in trace["traceEvents"] if e.get("ph") == "X"}
+    assert pids == set(summary["processes"]), (
+        f"merged trace tracks {pids} != processes {set(summary['processes'])}"
+    )
+    names = {e["name"] for e in trace["traceEvents"] if e.get("ph") == "X"}
+    assert {"epoch", "step"} <= names, f"merged trace missing driver spans: {names}"
+    # clock anchor came from the heartbeat, not the zero fallback
+    assert not summary["unanchored"], f"unanchored processes: {summary['unanchored']}"
+    return merged_path
+
+
+def assert_strict_report(workdir: str) -> None:
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "obs_report", os.path.join(os.path.dirname(os.path.abspath(__file__)), "obs_report.py")
+    )
+    rep = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(rep)
+    from moco_tpu.obs import schema
+
+    for p in rep.metrics_paths_for(workdir):
+        errors = schema.validate_file(p)
+        assert not errors, f"schema violations in {p}: {errors}"
+    report = rep.render_report(
+        rep.metrics_paths_for(workdir),
+        os.path.join(workdir, "merged_trace.json"),
+        workdir=workdir,
+    )
+    for section in ("## Fleet", "## Comms", "## Alerts", "straggler_skew"):
+        assert section in report, f"report missing {section!r}"
+    with open(os.path.join(workdir, "report.md"), "w") as f:
+        f.write(report + "\n")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description="fleet observability smoke")
+    ap.add_argument("--workdir", default=None, help="default: a fresh temp dir")
+    args = ap.parse_args()
+    workdir = args.workdir or tempfile.mkdtemp(prefix="fleet_smoke_")
+    os.makedirs(workdir, exist_ok=True)
+    out = run_smoke(workdir)
+    assert_surface(workdir)
+    merged = assert_merged_trace(workdir)
+    assert_strict_report(workdir)
+    print(
+        f"fleet smoke OK: {out['result']} — merged trace {merged}, "
+        f"artifacts in {workdir}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

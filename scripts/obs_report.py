@@ -5,11 +5,20 @@
     python scripts/obs_report.py WORKDIR --output report.md
     python scripts/obs_report.py WORKDIR --strict   # exit 1 on schema errors
 
-Renders, from `metrics.jsonl` (+ `trace.json` when present):
+Renders, from `metrics.jsonl` (+ per-process `metrics.p<i>.jsonl`
+siblings, `trace.json`, `alerts.jsonl`, and `heartbeat.p*.json` when
+present):
 
 - run shape: steps/epochs covered, wall time, logging cadence;
 - step-time breakdown: where the average step went (data wait vs
   dispatch vs device compute), as an ASCII "pie";
+- fleet view: straggler skew trend, the fleet-max step time vs the
+  mean, the most-blamed host, and a per-host heartbeat table that
+  flags hosts whose heartbeat went stale (died mid-run) — merged from
+  the out-of-band heartbeat files, so a dead host still appears;
+- comms: per-collective-site analytic wire bytes per step (from the
+  `comms/*` counters) with a share-of-total bar;
+- alerts: every fired alert from alerts.jsonl, grouped by rule;
 - training-health trends: loss/accuracy, EMA drift, InfoNCE pos/neg
   logit margin, feature-collapse gauges, queue staleness — first→last
   with min/max, so a drifting gauge is visible without plotting;
@@ -17,6 +26,10 @@ Renders, from `metrics.jsonl` (+ `trace.json` when present):
 - fault ledger: NaN steps, decode failures, per-site I/O retries,
   compile-cache misses, and every event line verbatim;
 - trace summary: total/self time by span name from the Chrome trace.
+
+When the source is a workdir, co-hosted processes' metrics files are
+globbed and merged (the per-process-filename satellite); `--strict`
+validates EVERY file against the schema.
 
 Needs only the stdlib + moco_tpu.obs.schema (no jax import, so it runs
 on any machine the JSONL was copied to). CI's obs-smoke step runs this
@@ -27,6 +40,7 @@ cannot rot.
 from __future__ import annotations
 
 import argparse
+import glob as globmod
 import json
 import os
 import sys
@@ -65,16 +79,39 @@ def _trend(lines: list[dict], key: str) -> str | None:
     )
 
 
-def render_report(metrics_path: str, trace_path: str | None = None) -> str:
-    records = schema.read_metrics(metrics_path, strict=False)
+def metrics_paths_for(source: str) -> list[str]:
+    """All per-process metrics files of a workdir (process 0's
+    `metrics.jsonl` first), or the single file the caller named."""
+    if not os.path.isdir(source):
+        return [source]
+    paths = []
+    base = os.path.join(source, "metrics.jsonl")
+    if os.path.exists(base):
+        paths.append(base)
+    paths.extend(sorted(globmod.glob(os.path.join(source, "metrics.p*.jsonl"))))
+    return paths
+
+
+def render_report(
+    metrics_path: str | list[str],
+    trace_path: str | None = None,
+    workdir: str | None = None,
+) -> str:
+    paths = [metrics_path] if isinstance(metrics_path, str) else list(metrics_path)
+    records = []
+    for p in paths:
+        records.extend(schema.read_metrics(p, strict=False))
+    if len(paths) > 1:  # merged multi-process view: one timeline
+        records.sort(key=lambda r: (r.get("time", 0.0), r.get("step", 0)))
     train_lines = [r for r in records if "loss" in r and "event" not in r]
     events = [r for r in records if "event" in r]
     out: list[str] = []
     w = out.append
 
+    src = paths[0] if len(paths) == 1 else f"{len(paths)} per-process files"
     w("# Telemetry report")
     w("")
-    w(f"source: `{metrics_path}` — {len(records)} lines "
+    w(f"source: `{src}` — {len(records)} lines "
       f"({len(train_lines)} training, {len(events)} events)")
     if not records:
         w("")
@@ -112,6 +149,88 @@ def render_report(metrics_path: str, trace_path: str | None = None) -> str:
     else:
         w("(no t_step fields — run predates the telemetry layer?)")
     w("")
+
+    # -- fleet view ------------------------------------------------------
+    skew = _trend(train_lines, "straggler_skew")
+    hosts = [r["fleet_hosts"] for r in train_lines if isinstance(r.get("fleet_hosts"), int)]
+    beats = {}
+    if workdir:
+        from moco_tpu.obs.fleet import read_heartbeats
+
+        beats = read_heartbeats(workdir)
+    if skew or hosts or beats:
+        w("## Fleet")
+        w("")
+        if hosts:
+            w(f"hosts reporting: {max(hosts)}")
+        if skew:
+            w(f"- `straggler_skew`: {skew}")
+        tmax = _trend(train_lines, "fleet/t_step_max")
+        tmean = _trend(train_lines, "fleet/t_step_mean")
+        if tmax:
+            w(f"- `fleet/t_step_max`: {tmax}")
+        if tmean:
+            w(f"- `fleet/t_step_mean`: {tmean}")
+        blames = [r["fleet/t_step_argmax"] for r in train_lines
+                  if isinstance(r.get("fleet/t_step_argmax"), int)]
+        if blames:
+            worst = max(set(blames), key=blames.count)
+            w(f"- slowest host (mode of `fleet/t_step_argmax`): "
+              f"host {worst} on {blames.count(worst)}/{len(blames)} lines")
+        if beats:
+            newest = max(b.get("time", 0.0) for b in beats.values())
+            w("")
+            w("heartbeats (out-of-band; a stale one means the host died mid-run):")
+            for p in sorted(beats):
+                b = beats[p]
+                lag = newest - b.get("time", 0.0)
+                flag = "  ** STALE — host died mid-run? **" if lag > 60.0 else ""
+                w(f"- host {p} ({b.get('host', '?')}): last beat at step "
+                  f"{b.get('step', '?')}, {lag:.0f}s behind the newest{flag}")
+        w("")
+
+    # -- comms (analytic wire bytes per collective site) -----------------
+    comms_line = next(
+        (r for r in reversed(train_lines)
+         if any(k.startswith("comms/") and k != "comms/total" for k in r)),
+        None,
+    )
+    if comms_line:
+        w("## Comms (analytic wire bytes per device, per step)")
+        w("")
+        sites = {
+            k[len("comms/"):]: v for k, v in comms_line.items()
+            if k.startswith("comms/") and k != "comms/total"
+            and isinstance(v, (int, float))
+        }
+        total = sum(sites.values()) or 1.0
+        for name, nbytes in sorted(sites.items(), key=lambda kv: -kv[1]):
+            frac = nbytes / total
+            w(f"  {name:<28} {_bar(frac)} {frac * 100:5.1f}%  "
+              f"({nbytes / 2**20:.2f} MiB/step)")
+        w(f"  total: {total / 2**20:.2f} MiB/step per device "
+          f"(collective cost model: moco_tpu/obs/comms.py)")
+        w("")
+
+    # -- alerts ----------------------------------------------------------
+    alerts = []
+    if workdir:
+        from moco_tpu.obs.alerts import read_alerts
+
+        alerts = read_alerts(os.path.join(workdir, "alerts.jsonl"))
+    if alerts:
+        w("## Alerts")
+        w("")
+        by_rule: dict[str, int] = {}
+        for a in alerts:
+            by_rule[a.get("rule", "?")] = by_rule.get(a.get("rule", "?"), 0) + 1
+        w("fired: " + ", ".join(f"`{r}` x{n}" for r, n in sorted(by_rule.items())))
+        for a in alerts[:20]:
+            w(f"- [{a.get('severity', '?')}] step {a.get('step', '?')} "
+              f"`{a.get('rule', '?')}`: {a.get('message', '')}")
+        if len(alerts) > 20:
+            w(f"- ... {len(alerts) - 20} more in alerts.jsonl")
+        w("")
 
     # -- device memory ---------------------------------------------------
     w("## Device memory")
@@ -211,19 +330,30 @@ def main() -> int:
     )
     args = ap.parse_args()
 
-    metrics_path = args.source
     trace_path = args.trace
-    if os.path.isdir(metrics_path):
+    workdir = None
+    if os.path.isdir(args.source):
+        workdir = args.source
         if trace_path is None:
-            cand = os.path.join(metrics_path, "trace.json")
-            trace_path = cand if os.path.exists(cand) else None
-        metrics_path = os.path.join(metrics_path, "metrics.jsonl")
-    if not os.path.exists(metrics_path):
-        print(f"error: {metrics_path} not found", file=sys.stderr)
+            # prefer the multi-process merged trace when one was built
+            for cand in ("merged_trace.json", "trace.json"):
+                cand = os.path.join(workdir, cand)
+                if os.path.exists(cand):
+                    trace_path = cand
+                    break
+        metrics_paths = metrics_paths_for(workdir)
+    else:
+        metrics_paths = [args.source]
+    missing = [p for p in metrics_paths if not os.path.exists(p)]
+    if missing or not metrics_paths:
+        print(f"error: {missing or args.source} not found", file=sys.stderr)
         return 2
 
-    errors = schema.validate_file(metrics_path)
-    report = render_report(metrics_path, trace_path)
+    errors = []
+    for p in metrics_paths:
+        tag = f"{os.path.basename(p)}: " if len(metrics_paths) > 1 else ""
+        errors.extend(tag + e for e in schema.validate_file(p))
+    report = render_report(metrics_paths, trace_path, workdir=workdir)
     if errors:
         report += "\n## Schema violations\n\n" + "\n".join(f"- {e}" for e in errors) + "\n"
     if args.output:

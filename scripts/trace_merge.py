@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""Merge per-process span streams into ONE Perfetto trace.
+
+    python scripts/trace_merge.py WORKDIR [--output merged_trace.json]
+
+A multi-process run writes one `trace_events.jsonl` per process
+(`trace_events.p<i>.jsonl` for process > 0) and one heartbeat file per
+process. Each stream's timestamps are relative to ITS tracer's start —
+on a pod the processes start seconds apart, so naive concatenation
+skews every track. This tool:
+
+1. discovers every per-process span stream under the workdir (the
+   records carry the process index in `p`; the filename is a fallback);
+2. reads the heartbeats' `trace_wall_t0` wall-clock anchors and shifts
+   each process's timestamps by `(wall_t0_p - min(wall_t0)) * 1e6` µs —
+   clock-offset correction, so "step 40 on host 3" lines up under
+   "step 40 on host 0" in the merged view;
+3. emits one Chrome trace-event JSON with pid = process index, a
+   `process_name` track label per host (hostname from the heartbeat
+   when known), and every thread preserved.
+
+Open the output in https://ui.perfetto.dev — one track group per host.
+A process with no heartbeat (it died before its first beat, or a
+pre-fleet run) merges with zero offset and a warning in `otherData`.
+
+Needs only the stdlib + moco_tpu.obs (no jax), so it runs wherever the
+files were copied.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from moco_tpu.obs.fleet import read_heartbeats  # noqa: E402
+from moco_tpu.obs.trace import spans_to_chrome_events  # noqa: E402
+
+_PROC_RE = re.compile(r"trace_events\.p(\d+)\.jsonl$")
+
+
+def discover_streams(workdir: str) -> dict[int, str]:
+    """{process_index: span-stream path} for every per-process stream
+    under `workdir`. `trace_events.jsonl` is process 0."""
+    streams: dict[int, str] = {}
+    base = os.path.join(workdir, "trace_events.jsonl")
+    if os.path.exists(base):
+        streams[0] = base
+    for path in glob.glob(os.path.join(workdir, "trace_events.p*.jsonl")):
+        m = _PROC_RE.search(path)
+        if m:
+            streams[int(m.group(1))] = path
+    return streams
+
+
+def read_spans(path: str) -> list[dict]:
+    """Parsed span records; a truncated tail line (crash mid-write) is
+    skipped, not fatal — merging a crashed run is the point."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue
+    return out
+
+
+def merge_traces(workdir: str, output: str) -> dict:
+    """Merge every per-process span stream under `workdir` into one
+    Chrome trace at `output`; returns a summary dict (process count,
+    span counts, applied offsets)."""
+    streams = discover_streams(workdir)
+    if not streams:
+        raise FileNotFoundError(f"no trace_events*.jsonl under {workdir}")
+    beats = read_heartbeats(workdir)
+    anchors = {
+        p: rec["trace_wall_t0"]
+        for p, rec in beats.items()
+        if isinstance(rec.get("trace_wall_t0"), (int, float))
+    }
+    origin = min(anchors.values()) if anchors else 0.0
+    events: list[dict] = []
+    summary = {"processes": {}, "unanchored": []}
+    for p in sorted(streams):
+        spans = read_spans(streams[p])
+        offset_us = (anchors[p] - origin) * 1e6 if p in anchors else 0.0
+        if p not in anchors:
+            summary["unanchored"].append(p)
+        host = beats.get(p, {}).get("host")
+        name = f"host {p}" + (f" ({host})" if host else "")
+        events.extend(
+            spans_to_chrome_events(
+                spans, pid=p, process_name=name, ts_offset_us=offset_us
+            )
+        )
+        summary["processes"][p] = {
+            "spans": len(spans),
+            "offset_us": round(offset_us, 1),
+            "host": host,
+        }
+    meta = {
+        "merged_from": len(streams),
+        "clock_origin_wall": origin,
+        "unanchored_processes": summary["unanchored"],
+    }
+    os.makedirs(os.path.dirname(os.path.abspath(output)), exist_ok=True)
+    with open(output, "w") as f:
+        json.dump(
+            {"traceEvents": events, "displayTimeUnit": "ms", "otherData": meta}, f
+        )
+    summary["output"] = output
+    return summary
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("workdir", help="run workdir holding trace_events*.jsonl (+ heartbeats)")
+    ap.add_argument(
+        "--output", "-o", default=None,
+        help="merged trace path (default: <workdir>/merged_trace.json)",
+    )
+    args = ap.parse_args()
+    output = args.output or os.path.join(args.workdir, "merged_trace.json")
+    try:
+        summary = merge_traces(args.workdir, output)
+    except FileNotFoundError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    for p, info in sorted(summary["processes"].items()):
+        host = f" host={info['host']}" if info["host"] else ""
+        print(
+            f"process {p}: {info['spans']} spans, clock offset "
+            f"{info['offset_us'] / 1e3:.1f} ms{host}"
+        )
+    if summary["unanchored"]:
+        print(
+            f"warning: no heartbeat clock anchor for processes "
+            f"{summary['unanchored']} — merged with zero offset",
+            file=sys.stderr,
+        )
+    print(f"wrote {output} (open in https://ui.perfetto.dev)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -195,8 +195,33 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--metrics-port", type=int, default=None,
-        help="serve Prometheus text format on http://127.0.0.1:PORT/metrics "
-        "(0 = off) for scraping long runs",
+        help="serve Prometheus text format on http://HOST:(PORT + "
+        "process_index)/metrics (0 = off) for scraping long runs; the "
+        "per-process shift keeps co-hosted processes from colliding",
+    )
+    p.add_argument(
+        "--metrics-host", default=None,
+        help="bind address for the Prometheus endpoint (default "
+        "127.0.0.1; use 0.0.0.0 for off-box scrapers)",
+    )
+    p.add_argument(
+        "--no-fleet-metrics", dest="fleet_metrics", action="store_false",
+        default=None,
+        help="disable cross-host fleet aggregation (fleet min/mean/max/"
+        "argmax + straggler_skew on process-0 metrics lines) and the "
+        "per-host heartbeat files",
+    )
+    p.add_argument(
+        "--alert-rules", default=None,
+        help="in-stream alert rules (moco_tpu/obs/alerts.py grammar): "
+        "'default' = built-ins (step-time spike, data starvation, "
+        "straggler skew, EMA runaway, queue staleness, non-finite loss, "
+        "stall, heartbeat loss); 'default,<spec>' extends; 'none' off",
+    )
+    p.add_argument(
+        "--alerts-fatal", action="store_true", default=None,
+        help="abort the run on any fired alert, after an emergency "
+        "checkpoint (reuses the fault-tolerance save-first path)",
     )
     p.add_argument(
         "--obs-probe-every", type=int, default=None,
@@ -284,8 +309,12 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         recompile_warmup_steps=args.recompile_warmup,
         sinks=args.sinks,
         metrics_port=args.metrics_port,
+        metrics_host=args.metrics_host,
         health_metrics=args.health_metrics,
         obs_probe_every=args.obs_probe_every,
+        fleet_metrics=args.fleet_metrics,
+        alert_rules=args.alert_rules,
+        alerts_fatal=args.alerts_fatal,
     )
 
 
