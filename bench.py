@@ -368,15 +368,25 @@ def main() -> None:
     )
 
     # ---- with-data rate (real pipeline in the loop) -------------------
-    with_data = None
-    if on_tpu and not os.environ.get("BENCH_SKIP_DATA"):
+    # Two legs since the device prefetch ring landed (ISSUE 5): the
+    # synchronous path (decode → transfer → dispatch take turns on one
+    # producer thread) vs the overlapped path (epoch(device=True):
+    # decode thread + transfer ring + pipelined steps). Both run on the
+    # CPU fallback too — the perf trajectory needs a non-null with-data
+    # series and an overlap A/B even when the TPU tunnel is down
+    # (BENCH_r05.json carried `with_data: null` for exactly that reason).
+    with_data = with_data_sync = overlap_efficiency = None
+    if not os.environ.get("BENCH_SKIP_DATA"):
         try:
             from moco_tpu.data.pipeline import TwoCropPipeline
 
             # drop-last pipeline: an epoch smaller than one batch yields
             # ZERO batches and the epoch roller below would spin forever
-            n_imgs = max(1024, batch)
-            folder = _ensure_jpeg_folder("/tmp/moco_bench_imgfolder", n_imgs, 256)
+            if on_tpu:
+                n_imgs, src_size = max(1024, batch), 256
+            else:  # CPU smoke: small synthetic folder, small geometry
+                n_imgs, src_size = max(256, batch), 64
+            folder = _ensure_jpeg_folder("/tmp/moco_bench_imgfolder", n_imgs, src_size)
             dconf = DataConfig(
                 dataset="imagefolder",
                 data_dir=folder,
@@ -394,34 +404,74 @@ def main() -> None:
             )
             pipe = TwoCropPipeline(dconf, mesh, seed=0)
 
-            def batches():  # roll over epochs so `steps` steps get measured
-                epoch = 0
-                while True:
-                    yield from pipe.epoch(epoch)
-                    epoch += 1
+            def _with_data_leg(device: bool, warm_steps: int):
+                """Sustained imgs/s (global) of `steps` real-pipeline
+                steps, plus the ring's TransferStats on the overlapped
+                leg. Rolls over epochs; closes abandoned iterators so
+                ring/producer threads never leak between legs."""
+                st, done, epoch = state, 0, 0
+                it = iter(pipe.epoch(epoch, device=device))
+
+                def _next():
+                    nonlocal it, epoch
+                    while True:
+                        b = next(it, None)
+                        if b is not None:
+                            return b
+                        getattr(it, "close", lambda: None)()
+                        epoch += 1
+                        it = iter(pipe.epoch(epoch, device=device))
+
+                for _ in range(warm_steps):
+                    b = _next()
+                st, m = step(st, b, root_rng)
+                float(m["loss"])
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    st, m = step(st, _next(), root_rng)
+                float(m["loss"])  # chained state deps force all steps
+                dt = time.perf_counter() - t0
+                stats = getattr(it, "stats", None)
+                getattr(it, "close", lambda: None)()
+                return batch * steps / dt, stats
 
             # warm a FULL first epoch before timing: the first pass over
             # a cold cache dir decodes every JPEG and writes the packed
             # cache — a one-time cost that otherwise lands inside the
             # timed loop and misreports the steady-state rate (the
             # ladder in PROFILE.md is steady-state)
-            it = batches()
             warm_steps = max(n_imgs // batch, 1)
-            for _ in range(warm_steps):
-                b0 = next(it)
-            state, metrics = step(state, b0, root_rng)
-            float(metrics["loss"])
-            data_steps = 0
+            sync_rate, _ = _with_data_leg(device=False, warm_steps=warm_steps)
+            over_rate, ring_stats = _with_data_leg(device=True, warm_steps=1)
+            with_data_sync = sync_rate / n_dev
+            with_data = over_rate / n_dev
+
+            # overlap_efficiency = achieved / min(host, device, wire):
+            # 1.0 means the overlapped loop runs at the binding stage's
+            # rate — nothing left to hide. Host rate drains the decode
+            # generator alone; device rate is the headline steady-state;
+            # wire rate converts the ring's measured MB/s to imgs/s.
             t0 = time.perf_counter()
-            for b in it:
-                state, metrics = step(state, b, root_rng)
-                data_steps += 1
-                if data_steps >= steps:
+            host_n = 0
+            for _ in pipe._host_gen(97):
+                host_n += 1
+                if host_n >= steps:
                     break
-            float(metrics["loss"])
-            ddt = time.perf_counter() - t0
-            if data_steps:
-                with_data = batch * data_steps / ddt / n_dev
+            host_rate = batch * host_n / (time.perf_counter() - t0)
+            bounds = [host_rate, imgs_per_sec]
+            if ring_stats is not None and ring_stats.batches:
+                wire_bps = ring_stats.wire_rate_bytes_per_sec()
+                bytes_per_img = ring_stats.total_bytes / ring_stats.batches / batch
+                if wire_bps and bytes_per_img:
+                    bounds.append(wire_bps / bytes_per_img)
+            overlap_efficiency = over_rate / min(bounds)
+            print(
+                f"with-data: sync={sync_rate:.1f} overlapped={over_rate:.1f} imgs/s "
+                f"(bounds host={host_rate:.1f} device={imgs_per_sec:.1f}"
+                + (f" wire={bounds[2]:.1f}" if len(bounds) > 2 else "")
+                + f") overlap_efficiency={overlap_efficiency:.3f}",
+                file=sys.stderr,
+            )
         except Exception as e:
             print(f"with-data bench failed: {e}", file=sys.stderr)
 
@@ -454,9 +504,18 @@ def main() -> None:
                 if on_tpu and not is_vit
                 else None,
                 "mfu": None if mfu is None else round(mfu, 4),
+                # overlapped (device prefetch ring) with-data rate; the
+                # sync leg and the efficiency ratio ride along so every
+                # BENCH record carries the overlap A/B (CPU smoke too)
                 "with_data_imgs_per_sec_per_chip": None
                 if with_data is None
                 else round(with_data, 2),
+                "with_data_sync_imgs_per_sec_per_chip": None
+                if with_data_sync is None
+                else round(with_data_sync, 2),
+                "overlap_efficiency": None
+                if overlap_efficiency is None
+                else round(overlap_efficiency, 3),
                 # telemetry-layer cost: full obs (health gauges + tracer
                 # + sink writes) vs bare, same compiled shapes
                 "obs_overhead_pct": obs_overhead_pct,

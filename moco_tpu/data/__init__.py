@@ -19,6 +19,7 @@ from moco_tpu.data.datasets import (
     SyntheticDataset,
     build_dataset,
 )
+from moco_tpu.data.device_prefetch import DevicePrefetchRing
 from moco_tpu.data.pipeline import EvalPipeline, TwoCropPipeline
 
 __all__ = [
@@ -39,6 +40,7 @@ __all__ = [
     "ImageFolderDataset",
     "SyntheticDataset",
     "build_dataset",
+    "DevicePrefetchRing",
     "EvalPipeline",
     "TwoCropPipeline",
 ]

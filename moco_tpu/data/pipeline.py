@@ -1,6 +1,6 @@
-"""Input pipeline: host decode → device augment → prefetch.
+"""Input pipeline: host decode → device transfer ring → device augment.
 
-Replaces the reference's `DataLoader(workers=32)` + `TwoCropsTransform`
+Replaces the reference's `DataLoader(workers=32)` + `TwoCropTransform`
 (`main_moco.py:~L255-260`, `moco/loader.py`). Split of labor:
 
 - host: index shuffling (per-epoch, seeded — the
@@ -9,17 +9,35 @@ Replaces the reference's `DataLoader(workers=32)` + `TwoCropsTransform`
   boxes sampled against each image's ORIGINAL geometry and executed in
   the loader (decode once, crop/resize N times — native C++ pool when
   built, else PIL threads); otherwise decode to a fixed uint8 canvas;
-- device: the remaining stochastic augmentation (jitter/gray/blur/flip/
-  normalize — plus the crop itself on the canvas path), batched and
-  jitted (`moco_tpu.data.augment`), already sharded over the mesh's
-  data axis;
-- a depth-2 prefetch queue overlaps host decode with the train step.
+- wire: uint8 crosses the host→device boundary (4x fewer bytes than
+  fp32), sharded over the mesh's data axis;
+- device: /255 + the remaining stochastic augmentation (jitter/gray/
+  blur/flip/normalize — plus the crop itself on the canvas path),
+  batched and jitted (`moco_tpu.data.augment`).
+
+Two epoch modes, bit-identical in output (same seeded order, same step
+rngs, same jitted augment):
+
+- `epoch(e)` — the synchronous path: one producer thread runs decode →
+  transfer → augment dispatch serially, a depth-2 prefetch queue
+  overlaps that whole chain with the train step;
+- `epoch(e, device=True)` — the overlapped path
+  (`data/device_prefetch.py`): the producer thread decodes batch k+2
+  while a dedicated transfer thread stages batch k+1 on device and the
+  driver dispatches step k. Decode, wire, and compute pipeline instead
+  of taking turns — the round-5 with-data ceiling lever (PROFILE.md).
 
 Training pipelines use drop_last=True semantics (reference DataLoader) —
 the queue's `K % global_batch == 0` invariant requires full batches. The
 eval pipeline instead pads the tail batch and carries a validity mask so
 the whole val split is scored (the reference evaluates the full split
 too).
+
+Every epoch iterator exposes `close()`: a consumer that abandons it
+mid-epoch (preemption, a step-loop exception) MUST call it — before the
+poison-pill close existed, the daemon producer stayed blocked on
+`q.put` forever, holding the decode pool (the PR-5 leak fix; the train
+driver closes on every epoch exit path).
 """
 
 from __future__ import annotations
@@ -27,12 +45,12 @@ from __future__ import annotations
 import queue
 import threading
 from concurrent.futures import ThreadPoolExecutor
-from typing import Iterator, Optional
+from typing import Iterator, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from moco_tpu.data.augment import (
     AugRecipe,
@@ -42,35 +60,133 @@ from moco_tpu.data.augment import (
     two_crop_augment,
 )
 from moco_tpu.data.datasets import build_dataset
+from moco_tpu.data.device_prefetch import DevicePrefetchRing
+from moco_tpu.obs import comms
 from moco_tpu.obs.trace import span as obs_span
 from moco_tpu.parallel.dist import ProcessDataPartition
-from moco_tpu.parallel.mesh import DATA_AXIS
+from moco_tpu.parallel.mesh import batch_sharding
 from moco_tpu.utils import faults, retry
 from moco_tpu.utils.config import DataConfig
 
+_END = object()
+_CLOSED = object()
 
-def _prefetch(it: Iterator, depth: int = 2) -> Iterator:
-    """Run the producer in a thread, keeping `depth` batches in flight."""
-    q: queue.Queue = queue.Queue(maxsize=depth)
-    _END = object()
 
-    def producer():
+def _responsive_put(q: queue.Queue, stop: threading.Event, item) -> bool:
+    """Bounded put that stays responsive to a stop flag; False = stopped."""
+    while not stop.is_set():
         try:
-            for item in it:
-                q.put(item)
-            q.put(_END)
-        except BaseException as e:  # surface producer errors to the consumer
-            q.put(e)
+            q.put(item, timeout=0.1)
+            return True
+        except queue.Full:
+            continue
+    return False
 
-    t = threading.Thread(target=producer, daemon=True)
-    t.start()
-    while True:
-        item = q.get()
-        if item is _END:
-            return
+
+def _producer_loop(src: Iterator, q: queue.Queue, stop: threading.Event) -> None:
+    """Prefetch producer body. A MODULE-LEVEL function on purpose: the
+    thread must not hold a reference to the iterator OBJECT, or the
+    abandoned-iterator safety net (`__del__` flips the stop flag) could
+    never fire — the thread would keep its owner alive forever."""
+    try:
+        for item in src:
+            if not _responsive_put(q, stop, item):
+                return
+        _responsive_put(q, stop, _END)
+    except BaseException as e:  # surface producer errors to the consumer
+        _responsive_put(q, stop, e)
+    finally:
+        close = getattr(src, "close", None)
+        if close is not None:
+            try:
+                close()
+            except Exception:
+                pass
+
+
+class _PrefetchIterator:
+    """Producer thread + bounded queue, with a poison-pill `close()`.
+
+    The producer keeps `depth` items in flight; errors it raises are
+    re-raised at the consumer's `next()`. `close()` is the leak fix: it
+    flips the stop flag, drains the queue (so a `put`-blocked producer
+    unblocks within one poll interval), enqueues a CLOSED pill (so a
+    `get`-blocked consumer on another thread unblocks too), closes the
+    source iterator (releasing the decode pool a suspended generator
+    would pin), and joins the thread. Idempotent, safe mid-epoch.
+
+    An iterator abandoned WITHOUT close() (a consumer that just drops
+    it) still self-cleans: the producer thread does not reference this
+    object, so GC runs `__del__`, which flips the stop flag and lets
+    the thread exit on its next put poll.
+    """
+
+    def __init__(self, it: Iterator, depth: int = 2, name: str = "prefetch"):
+        self._src = it
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=_producer_loop, args=(it, self._q, self._stop),
+            daemon=True, name=name,
+        )
+        self._thread.start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._stop.is_set():
+            raise StopIteration
+        item = self._q.get()
+        if item is _END or item is _CLOSED:
+            self._stop.set()  # later next() calls must not block
+            raise StopIteration
         if isinstance(item, BaseException):
+            self._stop.set()
             raise item
-        yield item
+        return item
+
+    def close(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        try:
+            self._q.put_nowait(_CLOSED)  # unblock a get()-blocked consumer
+        except queue.Full:
+            pass
+        self._thread.join(timeout=timeout)
+
+    def __del__(self):
+        self._stop.set()
+
+
+def _prefetch(it: Iterator, depth: int = 2) -> _PrefetchIterator:
+    """Run the producer in a thread, keeping `depth` batches in flight."""
+    return _PrefetchIterator(it, depth=depth)
+
+
+class HostBatch(NamedTuple):
+    """One step's host-side product: local uint8 rows, not yet on
+    device. `views` is (B_local, n_views, S, S, 3) on the host-crop
+    path (n_views precropped images per row) or (B_local, H, W, 3) on
+    the canvas path (`precropped=False`)."""
+
+    step: int
+    rng: jax.Array
+    views: np.ndarray
+    labels: Optional[np.ndarray]
+    precropped: bool
+
+    @property
+    def wire_bytes(self) -> int:
+        """uint8 payload this process puts on the wire for this batch."""
+        n = int(self.views.nbytes)
+        if self.labels is not None:
+            n += int(self.labels.nbytes)
+        return n
 
 
 class _HostPipeline:
@@ -105,22 +221,16 @@ class _HostPipeline:
         n = len(self.dataset)
         self.steps_per_epoch = n // self.batch_size if drop_last else -(-n // self.batch_size)
         self._pool = ThreadPoolExecutor(max_workers=max(config.num_workers, 1))
-        self._sharding = NamedSharding(mesh, P(DATA_AXIS))
+        # the wire sharding: batch rows over the data axis (mesh.py) —
+        # the same layout the prefetch ring stages uint8 into
+        self._sharding = batch_sharding(mesh)
         # Multi-host input sharding (DistributedSampler equivalent,
         # main_moco.py:~L258): this process decodes only the global-batch
         # rows owned by its addressable devices; single-host it holds all
         # rows, so one code path serves both.
         self._partition = ProcessDataPartition(self._sharding, self.batch_size)
 
-    def _put_batch(self, global_indices: np.ndarray) -> tuple[jax.Array, jax.Array]:
-        """Decode this process's rows of the step's global batch and
-        assemble (images, labels) as globally-sharded jax.Arrays."""
-        local_idx = self._partition.local_indices(global_indices)
-        raw, labels = self._host_batch(local_idx)
-        return (
-            self._partition.assemble(raw),
-            self._partition.assemble(np.asarray(labels, np.int32)),
-        )
+    # -- host stage (decode; numpy out, nothing on device) ---------------
 
     def _host_batch(self, indices: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         """(images uint8 stack, labels int32) via the native C++ batch path
@@ -133,6 +243,7 @@ class _HostPipeline:
 
         def _load():
             faults.maybe_io_error("data.read")
+            faults.maybe_delay("data.read")
             if hasattr(self.dataset, "load_batch"):  # native/loader.cc decode pool
                 imgs, labels = self.dataset.load_batch(indices)
                 return imgs, np.asarray(labels, np.int32)
@@ -148,34 +259,13 @@ class _HostPipeline:
         with obs_span("host_decode", n=len(indices)):
             return retry.retry_call(_load, site="data.read")
 
-    @property
-    def decode_failures(self) -> int:
-        """Cumulative undecodable samples seen by the underlying dataset
-        (zero-filled slots) — the train driver writes this to
-        metrics.jsonl so data corruption is visible, not silent."""
-        return int(getattr(self.dataset, "decode_failures", 0))
-
-    def _epoch_order(self, epoch: int) -> np.ndarray:
-        """Seeded shuffle per (seed, epoch) — sampler.set_epoch equivalent."""
-        return np.random.default_rng((self.seed, epoch)).permutation(len(self.dataset))
-
-    def _epoch_rng(self, epoch: int) -> jax.Array:
-        return jax.random.fold_in(jax.random.PRNGKey(self.seed), epoch)
-
-    @property
-    def host_crops(self) -> bool:
-        """Host-side RandomResizedCrop (decode-once/crop-N against the
-        ORIGINAL image geometry — torchvision-exact distribution, no
-        fixed-canvas clipping) when the dataset and config support it."""
-        return self.config.host_rrc and hasattr(self.dataset, "load_crop_batch")
-
-    def _put_crop_batch(
+    def _local_crop_batch(
         self, global_indices: np.ndarray, epoch: int, step: int,
         n_crops: int, scale: tuple, out_size: int,
-    ) -> tuple[jax.Array, jax.Array]:
+    ) -> tuple[np.ndarray, np.ndarray]:
         """Host-crop path: sample n_crops RRC boxes per image against its
-        original dims, decode once + crop/resize in the loader, assemble
-        globally sharded (B, n_crops, S, S, 3) uint8 + labels.
+        original dims, decode once + crop/resize in the loader; returns
+        this process's (B_local, n_crops, S, S, 3) uint8 rows + labels.
 
         The crop uniforms are drawn ONCE per step for the full global
         batch × crops from a (seed, epoch, step)-keyed generator, and
@@ -203,6 +293,7 @@ class _HostPipeline:
             u_local, np.repeat(dims, n_crops, axis=0), scale=scale
         ).reshape(len(local_idx), n_crops, 4)
         with obs_span("host_decode", n=len(local_idx), crops=n_crops):
+            faults.maybe_delay("data.read")
             raw, labels = retry.retry_call(
                 self.dataset.load_crop_batch,
                 local_idx,
@@ -211,11 +302,79 @@ class _HostPipeline:
                 pool=self._pool,
                 site="data.read",
             )
-        # assemble per crop on the HOST side: slicing the crop axis of an
-        # already-assembled global array would not be fully-addressable
-        # under multi-host
-        views = [self._partition.assemble(np.ascontiguousarray(raw[:, c])) for c in range(n_crops)]
-        return views, self._partition.assemble(np.asarray(labels, np.int32))
+        return raw, np.asarray(labels, np.int32)
+
+    # -- device stage (sharded uint8 device_put + labels) ----------------
+
+    def _assemble_views(self, hb: HostBatch) -> tuple[list[jax.Array], Optional[jax.Array]]:
+        """Sharded device_put of one host batch: per-crop uint8 views on
+        the host-crop path (slicing the crop axis of an already-assembled
+        global array would not be fully-addressable under multi-host),
+        the single canvas array otherwise. Registers the `input.h2d`
+        comms-ledger entry so the wire shows up in the byte tables next
+        to the ICI collectives."""
+        part = self._partition
+        with comms.tag("input.h2d", "device_put", (hb.views, hb.labels), axis_size=1):
+            if hb.precropped:
+                views = [
+                    part.assemble(np.ascontiguousarray(hb.views[:, c]))
+                    for c in range(hb.views.shape[1])
+                ]
+            else:
+                views = [part.assemble(hb.views)]
+            labels = (
+                part.assemble(hb.labels) if hb.labels is not None else None
+            )
+        return views, labels
+
+    @property
+    def decode_failures(self) -> int:
+        """Cumulative undecodable samples seen by the underlying dataset
+        (zero-filled slots) — the train driver writes this to
+        metrics.jsonl so data corruption is visible, not silent."""
+        return int(getattr(self.dataset, "decode_failures", 0))
+
+    def _epoch_order(self, epoch: int) -> np.ndarray:
+        """Seeded shuffle per (seed, epoch) — sampler.set_epoch equivalent."""
+        return np.random.default_rng((self.seed, epoch)).permutation(len(self.dataset))
+
+    def _epoch_rng(self, epoch: int) -> jax.Array:
+        return jax.random.fold_in(jax.random.PRNGKey(self.seed), epoch)
+
+    @property
+    def host_crops(self) -> bool:
+        """Host-side RandomResizedCrop (decode-once/crop-N against the
+        ORIGINAL image geometry — torchvision-exact distribution, no
+        fixed-canvas clipping) when the dataset and config support it."""
+        return self.config.host_rrc and hasattr(self.dataset, "load_crop_batch")
+
+    # -- epoch assembly (shared by the two-crop/labeled pipelines) -------
+
+    def _epoch_iter(self, host_gen, stage, device: bool, depth: Optional[int], donate: bool):
+        """Wire one epoch's host generator + device stage into either
+        mode (module docstring): sync = both on one producer thread;
+        device=True = decode thread → transfer ring → consumer."""
+        depth = 2 if depth is None else int(depth)
+        if device:
+            host_it = _prefetch(host_gen, depth=depth)
+            return DevicePrefetchRing(
+                host_it, lambda hb: stage(hb, donate), depth=depth
+            )
+
+        def gen():
+            for hb in host_gen:
+                out, _ = stage(hb, donate)
+                yield out
+
+        return _prefetch(gen(), depth=depth)
+
+
+def _jit_pair(fn, donate_argnums: tuple):
+    """(plain, donating) jitted variants of one augment fn. The donating
+    variant recycles the consumed staging slot's HBM for the normalized
+    output (prefetch_donate) — a separate executable, compiled only if
+    donation is ever requested."""
+    return jax.jit(fn), jax.jit(fn, donate_argnums=donate_argnums)
 
 
 class TwoCropPipeline(_HostPipeline):
@@ -228,51 +387,63 @@ class TwoCropPipeline(_HostPipeline):
         )
         recipe, out_size = self.recipe, config.image_size
 
-        @jax.jit
         def _augment(rng, raw_uint8):
             images = raw_uint8.astype(jnp.float32) / 255.0
             return two_crop_augment(recipe, rng, images, out_size)
 
-        self._augment = _augment
+        self._augment, self._augment_donated = _jit_pair(_augment, (1,))
 
         # host-crop variant: images arrive already cropped to out_size;
         # the device applies everything in the recipe EXCEPT the crop
         nocrop = recipe._replace(crop=False)
 
-        @jax.jit
         def _augment_precropped(rng, q_uint8, k_uint8):
             k_q, k_k = jax.random.split(rng)
             q = apply_recipe(nocrop, k_q, q_uint8.astype(jnp.float32) / 255.0, out_size)
             k = apply_recipe(nocrop, k_k, k_uint8.astype(jnp.float32) / 255.0, out_size)
             return {"im_q": q, "im_k": k}
 
-        self._augment_precropped = _augment_precropped
+        self._augment_precropped, self._augment_precropped_donated = _jit_pair(
+            _augment_precropped, (1, 2)
+        )
 
-    def epoch(self, epoch: int) -> Iterator[dict]:
+    def _host_gen(self, epoch: int):
         order, rng = self._epoch_order(epoch), self._epoch_rng(epoch)
+        for step in range(self.steps_per_epoch):
+            idx = order[step * self.batch_size : (step + 1) * self.batch_size]
+            step_rng = jax.random.fold_in(rng, step)
+            if self.host_crops:
+                raw, _ = self._local_crop_batch(
+                    idx, epoch, step, n_crops=2,
+                    scale=self.recipe.crop_scale,
+                    out_size=self.config.image_size,
+                )
+                yield HostBatch(step, step_rng, raw, None, precropped=True)
+            else:
+                raw, _ = self._host_batch(self._partition.local_indices(idx))
+                yield HostBatch(step, step_rng, raw, None, precropped=False)
 
-        def gen():
-            for step in range(self.steps_per_epoch):
-                idx = order[step * self.batch_size : (step + 1) * self.batch_size]
-                step_rng = jax.random.fold_in(rng, step)
-                if self.host_crops:
-                    (q_raw, k_raw), _ = self._put_crop_batch(
-                        idx, epoch, step, n_crops=2,
-                        scale=self.recipe.crop_scale,
-                        out_size=self.config.image_size,
-                    )  # two (B, S, S, 3) sharded views
-                    # span closed BEFORE the yield: a generator suspends
-                    # inside `with`, which would bill consumer time to it
-                    with obs_span("augment_dispatch", step=step):
-                        out = self._augment_precropped(step_rng, q_raw, k_raw)
-                    yield out
-                else:
-                    raw, _ = self._put_batch(idx)
-                    with obs_span("augment_dispatch", step=step):
-                        out = self._augment(step_rng, raw)
-                    yield out
+    def _stage(self, hb: HostBatch, donate: bool):
+        views, _ = self._assemble_views(hb)
+        # span closed BEFORE the batch is handed on: a generator/queue
+        # suspends inside `with`, which would bill consumer time to it
+        with obs_span("augment_dispatch", step=hb.step):
+            if hb.precropped:
+                aug = self._augment_precropped_donated if donate else self._augment_precropped
+                out = aug(hb.rng, views[0], views[1])
+            else:
+                aug = self._augment_donated if donate else self._augment
+                out = aug(hb.rng, views[0])
+        return out, hb.wire_bytes
 
-        return _prefetch(gen(), depth=2)
+    def epoch(
+        self,
+        epoch: int,
+        device: bool = False,
+        depth: Optional[int] = None,
+        donate: bool = False,
+    ) -> Iterator[dict]:
+        return self._epoch_iter(self._host_gen(epoch), self._stage, device, depth, donate)
 
 
 class LabeledPipeline(_HostPipeline):
@@ -285,40 +456,55 @@ class LabeledPipeline(_HostPipeline):
         self.recipe = PROBE_RECIPE._replace(mean=base.mean, std=base.std)
         recipe, out_size = self.recipe, config.image_size
 
-        @jax.jit
         def _augment(rng, raw_uint8):
             images = raw_uint8.astype(jnp.float32) / 255.0
             return apply_recipe(recipe, rng, images, out_size)
 
-        self._augment = _augment
+        self._augment, self._augment_donated = _jit_pair(_augment, (1,))
         nocrop = recipe._replace(crop=False)
 
-        @jax.jit
         def _augment_precropped(rng, raw_uint8):
             images = raw_uint8.astype(jnp.float32) / 255.0
             return apply_recipe(nocrop, rng, images, out_size)
 
-        self._augment_precropped = _augment_precropped
+        self._augment_precropped, self._augment_precropped_donated = _jit_pair(
+            _augment_precropped, (1,)
+        )
 
-    def epoch(self, epoch: int) -> Iterator[tuple]:
+    def _host_gen(self, epoch: int):
         order, rng = self._epoch_order(epoch), self._epoch_rng(epoch)
+        for step in range(self.steps_per_epoch):
+            idx = order[step * self.batch_size : (step + 1) * self.batch_size]
+            step_rng = jax.random.fold_in(rng, step)
+            if self.host_crops:
+                raw, labels = self._local_crop_batch(
+                    idx, epoch, step, n_crops=1,
+                    scale=self.recipe.crop_scale,
+                    out_size=self.config.image_size,
+                )
+                yield HostBatch(step, step_rng, raw, labels, precropped=True)
+            else:
+                raw, labels = self._host_batch(self._partition.local_indices(idx))
+                yield HostBatch(step, step_rng, raw, labels, precropped=False)
 
-        def gen():
-            for step in range(self.steps_per_epoch):
-                idx = order[step * self.batch_size : (step + 1) * self.batch_size]
-                step_rng = jax.random.fold_in(rng, step)
-                if self.host_crops:
-                    (raw,), labels = self._put_crop_batch(
-                        idx, epoch, step, n_crops=1,
-                        scale=self.recipe.crop_scale,
-                        out_size=self.config.image_size,
-                    )
-                    yield self._augment_precropped(step_rng, raw), labels
-                else:
-                    raw, labels = self._put_batch(idx)
-                    yield self._augment(step_rng, raw), labels
+    def _stage(self, hb: HostBatch, donate: bool):
+        views, labels = self._assemble_views(hb)
+        with obs_span("augment_dispatch", step=hb.step):
+            if hb.precropped:
+                aug = self._augment_precropped_donated if donate else self._augment_precropped
+            else:
+                aug = self._augment_donated if donate else self._augment
+            out = aug(hb.rng, views[0])
+        return (out, labels), hb.wire_bytes
 
-        return _prefetch(gen(), depth=2)
+    def epoch(
+        self,
+        epoch: int,
+        device: bool = False,
+        depth: Optional[int] = None,
+        donate: bool = False,
+    ) -> Iterator[tuple]:
+        return self._epoch_iter(self._host_gen(epoch), self._stage, device, depth, donate)
 
 
 class EvalPipeline(_HostPipeline):

@@ -21,6 +21,7 @@ can `import moco_tpu.obs.schema` on a machine without a backend."""
 
 from moco_tpu.obs.trace import (  # stdlib-only, eager
     Tracer,
+    counter,
     get_tracer,
     instant,
     set_tracer,
@@ -63,6 +64,7 @@ def __getattr__(name):
 
 __all__ = [
     "Tracer",
+    "counter",
     "get_tracer",
     "set_tracer",
     "span",

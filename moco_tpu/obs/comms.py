@@ -35,6 +35,10 @@ this device's shard):
     psum_scatter   b * (n-1)/n      reduce-scatter half only
     ppermute       b                one neighbor hop per call
     broadcast      b
+    device_put     b                host→device: the payload crosses
+                                    PCIe/DMA once, independent of any
+                                    mesh axis (axis_size is ignored) —
+                                    the input wire's `input.h2d` site
 
 These are the standard ring-collective volumes ("How to Scale Your
 Model" §collectives); they are *analytic* counters, not measurements —
@@ -68,6 +72,7 @@ COLLECTIVES = (
     "psum_scatter",
     "ppermute",
     "broadcast",
+    "device_put",
 )
 
 
@@ -91,6 +96,10 @@ def collective_bytes(collective: str, nbytes: int, axis_size: int) -> int:
     n = int(axis_size)
     if collective not in COLLECTIVES:
         raise ValueError(f"unknown collective {collective!r} (known: {COLLECTIVES})")
+    if collective == "device_put":
+        # host→device transfer, not a ring collective: the bytes cross
+        # the wire once whatever the axis size (including 1)
+        return nbytes
     if n <= 1:
         return 0
     if collective == "all_gather":

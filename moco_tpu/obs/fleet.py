@@ -7,8 +7,9 @@ only symptom is global wall clock. This module gives the driver a fleet
 view at log-step cadence:
 
 - `FleetAggregator`: each process contributes a small fixed-width
-  per-host stats vector (`FLEET_FIELDS`: data wait, step wall, dispatch
-  lag, io retries, decode failures, live HBM); a jitted `all_gather` +
+  per-host stats vector (`FLEET_FIELDS`: data wait, step wall, wire
+  transfer time, dispatch lag, io retries, decode failures, live HBM);
+  a jitted `all_gather` +
   reduction over a one-device-per-host mesh returns per-field
   min/mean/max/argmax plus a `straggler_skew` gauge — `(max(t_step) -
   mean(t_step)) / mean(t_step)`, the fraction of every step the fleet
@@ -51,6 +52,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 FLEET_FIELDS = (
     "t_data",
     "t_step",
+    # per-batch host→device transfer seconds (device prefetch ring,
+    # data/device_prefetch.py) — lets straggler skew attribute to the
+    # WIRE: a host whose t_step is fat but whose t_transfer is fatter
+    # is PCIe/DMA-bound, not compute-bound. NaN on sync-path runs.
+    "t_transfer",
     "dispatch_lag",
     "io_retries",
     "decode_failures",

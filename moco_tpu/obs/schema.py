@@ -9,7 +9,9 @@ Line kinds (all carry `step` int + `time` float):
 
 - *training lines*: `loss` present -> require `epoch`/`lr`/`acc1`/
   `acc5`; optionally the step-time breakdown (`t_data`/`t_step`, and
-  `t_dispatch`/`t_device` on probe-sampled lines), device-memory gauges
+  `t_dispatch`/`t_device` on probe-sampled lines), the input-wire
+  gauges (`t_transfer`/`transfer_bytes`/`prefetch_depth_live` when the
+  device prefetch ring is on), device-memory gauges
   (`hbm_live_bytes`/`hbm_peak_bytes`, number or null), health gauges
   (`ema_drift*`, `logit_*`, `feature_*`, `queue_age_*`), and the fault
   counters (`nan_steps`/`decode_failures`/`io_retries` when nonzero,
@@ -81,6 +83,14 @@ FIELD_VALIDATORS = {
     "t_step": _num,
     "t_dispatch": _num_or_null,
     "t_device": _num,
+    # input wire (data/device_prefetch.py — present when the device
+    # prefetch ring is on): last batch's host→device transfer seconds,
+    # its uint8 wire bytes, and how many staged batches were resident
+    # when the driver consumed the last one (0 = the wire is the
+    # bottleneck, depth = the device is)
+    "t_transfer": _num,
+    "transfer_bytes": _int_like,
+    "prefetch_depth_live": _int_like,
     # device memory gauges (null where the backend lacks memory_stats)
     "hbm_live_bytes": _num_or_null,
     "hbm_peak_bytes": _num_or_null,

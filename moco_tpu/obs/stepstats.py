@@ -1,10 +1,14 @@
 """Step-time breakdown probe + device-memory gauges.
 
-Where does a step's wall time go? Three places the bare loss line can't
+Where does a step's wall time go? Four places the bare loss line can't
 distinguish:
 
 - *host data wait* — the step loop blocked on the prefetch queue
   (input-bound run);
+- *wire* — host→device transfer of the batch (reported separately as
+  `t_transfer` by the device prefetch ring, data/device_prefetch.py,
+  which runs the wire on its own thread so it overlaps both of the
+  stages below);
 - *dispatch* — host-side time to enqueue the jitted step (tracing,
   argument placement, python overhead);
 - *device compute* — the accelerator actually executing.
@@ -44,6 +48,13 @@ class StepTimeProbe:
     `payload()` returns the fields for the metrics line: always
     `t_data`/`t_step`; `t_dispatch`/`t_device` from the most recent
     sampled step (absent until one happened).
+
+    Under the software-pipelined driver loop (ISSUE 5) the log-step
+    fetch is deferred one dispatch, so `step_done` receives the
+    SMOOTHED per-step wall — (wall since the previous logged flush) /
+    (steps since it) — rather than one bursty iteration's host wall;
+    per-iteration wall under pipelining is just dispatch time and would
+    read ~0 between throttle waits.
     """
 
     def __init__(self, every: int = 0):

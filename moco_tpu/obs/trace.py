@@ -154,6 +154,27 @@ class Tracer:
         t = time.perf_counter()
         self._emit(name, t, t, len(self._stack()), {**args, "instant": True}, None)
 
+    def counter(self, name: str, **values) -> None:
+        """Numeric time series (Chrome `ph:"C"` counter events): the
+        device prefetch ring charts its live staged depth this way, so
+        Perfetto shows the input pipeline filling/draining against the
+        step spans. `values` are the series of one counter track."""
+        rec = {
+            "name": name,
+            "ts": round((time.perf_counter() - self._t0) * 1e6, 1),
+            "tid": threading.get_ident(),
+            "thread": threading.current_thread().name,
+            "p": self.process_index,
+            "counter": {k: float(v) for k, v in values.items()},
+        }
+        with self._lock:
+            if len(self._spans) < self.max_spans:
+                self._spans.append(rec)
+            else:
+                self._dropped += 1
+            if self._f is not None and not self._f.closed:
+                self._f.write(json.dumps(rec) + "\n")
+
     # -- export ----------------------------------------------------------
 
     def snapshot(self) -> list[dict]:
@@ -210,6 +231,18 @@ def spans_to_chrome_events(
     for s in spans:
         tid = s.get("tid", 0)
         thread_names.setdefault(tid, s.get("thread", f"thread-{tid}"))
+        if "counter" in s:  # numeric series -> Chrome counter track
+            events.append(
+                {
+                    "name": s["name"],
+                    "ph": "C",
+                    "ts": s["ts"] + ts_offset_us,
+                    "pid": pid,
+                    "tid": tid,
+                    "args": s["counter"],
+                }
+            )
+            continue
         ev = {
             "name": s["name"],
             "ph": "X",
@@ -269,3 +302,9 @@ def instant(name: str, **args) -> None:
     t = _tracer
     if t is not None:
         t.instant(name, **args)
+
+
+def counter(name: str, **values) -> None:
+    t = _tracer
+    if t is not None:
+        t.counter(name, **values)

@@ -101,7 +101,13 @@ def create_multislice_mesh(num_model: int = 1) -> Mesh:
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
-    """Batch dimension sharded over the data axis, rest replicated."""
+    """Batch dimension sharded over the data axis, rest replicated.
+
+    This is the input pipeline's WIRE layout: the device prefetch ring
+    (`data/device_prefetch.py`) stages uint8 batches into it from its
+    transfer thread (per-device shards assembled by
+    `dist.ProcessDataPartition`), and the jitted train step consumes it
+    without a resharding copy."""
     return NamedSharding(mesh, P(DATA_AXIS))
 
 
@@ -110,6 +116,12 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
 
 
 def shard_batch(mesh: Mesh, batch):
-    """Device_put a host batch with the leading dim sharded over `data`."""
+    """Device_put a host batch with the leading dim sharded over `data`.
+
+    One-shot staging (benches, eval, tests). The TRAINING hot path does
+    not go through here — per-step batches ride the device prefetch
+    ring, which also accounts its wire bytes to the `input.h2d` comms
+    ledger; a one-off staged batch is deliberately not a ledger entry
+    (it is not per-step traffic)."""
     s = batch_sharding(mesh)
     return jax.tree.map(lambda x: jax.device_put(x, s), batch)

@@ -20,6 +20,7 @@ import os
 import signal
 import threading
 import time
+from collections import deque
 from typing import Optional
 
 import jax
@@ -456,6 +457,21 @@ def _train_impl(
     # and the log lines — step_fn advances state.step once per dispatch
     # (even on NaN rollback), so the mirror never drifts.
     gstep_host = int(state.step)
+    # Software-pipelined step loop (ISSUE 5 tentpole): step k is
+    # dispatched against an already device-resident batch while the
+    # prefetch ring transfers k+1 and the host decodes k+2. Two loop
+    # mechanics make the overlap real:
+    # - bounded in-flight window: after each dispatch the loop blocks on
+    #   the metrics of the step `prefetch_depth` dispatches BACK (ready
+    #   or nearly so in steady state) — backpressure without ever
+    #   draining the device queue;
+    # - deferred log fetch: a log step's device_get runs one iteration
+    #   LATER, after the next step is already queued behind it, so a log
+    #   boundary no longer idles the device. Consequence: a non-finite
+    #   loss is detected one step late and the rollback also discards
+    #   the single in-flight update computed from the poisoned state —
+    #   same counters, one extra discarded step.
+    pipeline_depth = max(int(config.prefetch_depth), 1)
     try:
         with profiler_trace(profile_dir):
             for epoch in range(start_epoch, config.optim.epochs):
@@ -471,175 +487,238 @@ def _train_impl(
                     prefix=f"Epoch: [{epoch}]",
                 )
                 guard["epoch"] = epoch
-                it = iter(pipeline.epoch(epoch))
-                end = time.perf_counter()
+                it = iter(pipeline.epoch(
+                    epoch,
+                    device=config.device_prefetch,
+                    depth=config.prefetch_depth,
+                    donate=config.prefetch_donate,
+                ))
+                ring_stats = getattr(it, "stats_payload", None)
+                # wall anchor for the smoothed per-step time: t_step on a
+                # logged line is (wall since the previous logged flush) /
+                # (steps since it) — the sustained rate, which under the
+                # pipelined loop is the meaningful number (per-iteration
+                # host wall is just dispatch, ~ms)
+                flush_anchor = {"wall": time.perf_counter(), "gstep": gstep_host}
                 stop_now = False
-                for i in range(steps_per_epoch):
-                    if profile_window is not None:
-                        profile_window.on_step(gstep_host)
-                    fetch0 = time.perf_counter()
-                    with obs.span("data_wait", step=gstep_host):
-                        batch = next(it, None)
-                    if batch is None:
-                        break
-                    t_data = time.perf_counter() - fetch0
-                    data_time.update(t_data)
-                    probe.data_wait(t_data)
-                    t_disp0 = time.perf_counter()
-                    with obs.span("step", step=gstep_host):
-                        state, metrics = step_fn(state, batch, root_rng)
-                    probe.dispatched(time.perf_counter() - t_disp0)
-                    if probe.should_sample(gstep_host):
-                        # drain the device queue ON SAMPLED STEPS ONLY,
-                        # splitting host dispatch from device compute —
-                        # every other step stays sync-free
-                        with obs.span("device_wait", step=gstep_host):
-                            t_dev0 = time.perf_counter()
-                            jax.block_until_ready((state, metrics))
-                        probe.device_block(time.perf_counter() - t_dev0)
-                    gstep_host += 1
-                    if wd is not None:
-                        wd.beat()  # a timestamp assignment — no device sync
-                    if preempted["count"]:
-                        stop_now = True
-                        break
-                    if i % config.log_every == 0 or i == steps_per_epoch - 1:
-                        # host sync only on log steps — keeps the device
-                        # queue full; ALL runtime guards piggyback on this
-                        # fetch. ONE batched device_get for the whole
-                        # metrics tree: the old per-field float() forced a
-                        # blocking transfer per metric (obs satellite fix,
-                        # transfer-counted in tests/test_obs.py).
-                        fetched = jax.device_get(metrics)
-                        m = {
-                            k: (float(v) if getattr(v, "ndim", 1) == 0 else v)
-                            for k, v in fetched.items()
-                        }
-                        gstep = gstep_host
-                        if faults.enabled():  # chaos harness hooks
-                            m["loss"] = faults.corrupt_loss(m["loss"], gstep)
-                            faults.maybe_stall(gstep)
-                            faults.maybe_preempt(gstep)
-                        if not math.isfinite(m["loss"]):
-                            # non-finite-loss guard: skip the poisoned
-                            # update (params/opt/queue roll back to the
-                            # last finite log step; the step counter keeps
-                            # advancing so checkpoint ids stay monotonic
-                            # and fault-free/faulted runs agree on step
-                            # counts), count it, abort past the threshold.
-                            guard["nan_steps"] += 1
+                pending: Optional[dict] = None
+                inflight: deque = deque()
+
+                def flush_log(p: dict) -> None:
+                    """Deferred log-step processing: ONE batched
+                    device_get for the whole metrics tree (the old
+                    per-field float() forced a blocking transfer per
+                    metric), then every runtime guard piggybacks on the
+                    fetch — NaN guard, chaos hooks, alert engine,
+                    recompile guard, fleet gather, heartbeat."""
+                    nonlocal state
+                    i, gstep = p["i"], p["gstep"]
+                    fetched = jax.device_get(p["metrics"])
+                    m = {
+                        k: (float(v) if getattr(v, "ndim", 1) == 0 else v)
+                        for k, v in fetched.items()
+                    }
+                    if faults.enabled():  # chaos harness hooks
+                        m["loss"] = faults.corrupt_loss(m["loss"], gstep)
+                        faults.maybe_stall(gstep)
+                        faults.maybe_preempt(gstep)
+                    if not math.isfinite(m["loss"]):
+                        # non-finite-loss guard: skip the poisoned
+                        # update (params/opt/queue roll back to the
+                        # last finite log step; the step counter keeps
+                        # advancing so checkpoint ids stay monotonic
+                        # and fault-free/faulted runs agree on step
+                        # counts), count it, abort past the threshold.
+                        guard["nan_steps"] += 1
+                        writer.write(
+                            gstep,
+                            {"epoch": epoch, "event": "nonfinite_loss",
+                             "nan_steps": guard["nan_steps"]},
+                        )
+                        writer.fsync()
+                        if engine is not None:
+                            handle_alerts(
+                                gstep, epoch,
+                                engine.observe(
+                                    gstep,
+                                    {"event": "nonfinite_loss",
+                                     "nan_steps": guard["nan_steps"]},
+                                ),
+                            )
+                        print0(
+                            f"WARNING: non-finite loss at step {gstep} "
+                            f"({guard['nan_steps']}/{config.nan_guard_threshold})"
+                            " — update skipped",
+                            flush=True,
+                        )
+                        if guard["nan_steps"] >= config.nan_guard_threshold:
+                            raise FloatingPointError(
+                                f"aborting: {guard['nan_steps']} non-finite "
+                                f"loss steps (threshold "
+                                f"{config.nan_guard_threshold}); last at step "
+                                f"{gstep}, epoch {epoch}, lr "
+                                f"{float(lr_schedule(gstep - 1)):.3e} — see "
+                                f"{writer.path}"
+                            )
+                        state = guard["good_state"].replace(step=state.step)
+                        inflight.clear()  # poisoned-lineage refs: drop them
+                        return
+                    # p["state"] is the state AS OF this logged step —
+                    # `state` itself may already be one dispatch ahead
+                    guard["good_state"] = p["state"]
+                    bs = config.data.global_batch
+                    losses.update(m["loss"], bs)
+                    top1.update(m["acc1"], bs)
+                    top5.update(m["acc5"], bs)
+                    now = time.perf_counter()
+                    steps_since = max(gstep - flush_anchor["gstep"], 1)
+                    t_step = (now - flush_anchor["wall"]) / steps_since
+                    flush_anchor["wall"], flush_anchor["gstep"] = now, gstep
+                    batch_time.update(t_step)
+                    # re-pin the probe to THIS step's data wait: the next
+                    # iteration's fetch already overwrote it before this
+                    # deferred flush ran
+                    probe.data_wait(p["t_data"])
+                    probe.step_done(t_step)
+                    progress.display(i)
+                    wire = ring_stats() if ring_stats is not None else {}
+                    payload = {
+                        "epoch": epoch,
+                        "lr": float(lr_schedule(gstep - 1)),
+                        **m,
+                        # step-time breakdown + device memory
+                        # (obs): t_data/t_step always; dispatch/
+                        # device split from the latest sampled
+                        # step; hbm gauges null where the backend
+                        # lacks memory_stats (CPU hosts)
+                        **probe.payload(),
+                        **memory_payload(),
+                        # input wire (device prefetch ring): last
+                        # batch's transfer time/bytes + live staged
+                        # depth — absent on the sync path
+                        **wire,
+                    }
+                    # fault-tolerance observability: only present
+                    # when nonzero, so clean runs keep clean lines
+                    if guard["nan_steps"]:
+                        payload["nan_steps"] = guard["nan_steps"]
+                    decode_failures = getattr(pipeline, "decode_failures", 0)
+                    if decode_failures:
+                        payload["decode_failures"] = decode_failures
+                    io_retries = retry.snapshot()
+                    if io_retries:
+                        payload["io_retries"] = io_retries
+                    if compile_monitor is not None:
+                        # always present under --strict-tracing
+                        # (not only-when-nonzero like the fault
+                        # counters): dashboards watch it for
+                        # FLATNESS, and absence would read as 0
+                        misses = compile_monitor.misses()
+                        payload["compile_cache_misses"] = misses
+                    # comms ledger: analytic per-step wire bytes
+                    # for every collective the step traced
+                    # (obs/comms.py) — static values, no syncs
+                    payload.update(comms.payload())
+                    if fleet is not None:
+                        # cross-host aggregation: EVERY process
+                        # contributes its vector (this is a
+                        # collective, keyed on the replicated
+                        # log schedule so all hosts agree);
+                        # process 0's line carries the fleet view
+                        stats = fleet.gather(
+                            fleet.host_vector(
+                                t_data=payload.get("t_data"),
+                                t_step=payload.get("t_step"),
+                                t_transfer=wire.get("t_transfer"),
+                                dispatch_lag=probe.last_dispatch,
+                                io_retries=float(
+                                    sum(io_retries.values())
+                                ) if io_retries else 0.0,
+                                decode_failures=float(decode_failures),
+                                hbm_live=payload.get("hbm_live_bytes"),
+                            )
+                        )
+                        if fleet.process_index == 0:
+                            payload.update(fleet.payload(stats))
+                    heartbeat.beat(step=gstep, epoch=epoch)
+                    writer.write(gstep, payload)
+                    if engine is not None:
+                        handle_alerts(
+                            gstep, epoch, engine.observe(gstep, payload)
+                        )
+                    if recompile_guard is not None:
+                        diagnosis = recompile_guard.update(gstep, misses)
+                        if diagnosis is not None:
                             writer.write(
                                 gstep,
-                                {"epoch": epoch, "event": "nonfinite_loss",
-                                 "nan_steps": guard["nan_steps"]},
+                                {"epoch": epoch,
+                                 "event": "recompile_after_warmup",
+                                 "compile_cache_misses": misses},
                             )
                             writer.fsync()
-                            if engine is not None:
-                                handle_alerts(
-                                    gstep, epoch,
-                                    engine.observe(
-                                        gstep,
-                                        {"event": "nonfinite_loss",
-                                         "nan_steps": guard["nan_steps"]},
-                                    ),
-                                )
-                            print0(
-                                f"WARNING: non-finite loss at step {gstep} "
-                                f"({guard['nan_steps']}/{config.nan_guard_threshold})"
-                                " — update skipped",
-                                flush=True,
-                            )
-                            if guard["nan_steps"] >= config.nan_guard_threshold:
-                                raise FloatingPointError(
-                                    f"aborting: {guard['nan_steps']} non-finite "
-                                    f"loss steps (threshold "
-                                    f"{config.nan_guard_threshold}); last at step "
-                                    f"{gstep}, epoch {epoch}, lr "
-                                    f"{float(lr_schedule(gstep - 1)):.3e} — see "
-                                    f"{writer.path}"
-                                )
-                            state = guard["good_state"].replace(step=state.step)
-                        else:
-                            guard["good_state"] = state
-                            bs = config.data.global_batch
-                            losses.update(m["loss"], bs)
-                            top1.update(m["acc1"], bs)
-                            top5.update(m["acc5"], bs)
-                            t_step = time.perf_counter() - end
-                            batch_time.update(t_step)
-                            probe.step_done(t_step)
-                            progress.display(i)
-                            payload = {
-                                "epoch": epoch,
-                                "lr": float(lr_schedule(gstep - 1)),
-                                **m,
-                                # step-time breakdown + device memory
-                                # (obs): t_data/t_step always; dispatch/
-                                # device split from the latest sampled
-                                # step; hbm gauges null where the backend
-                                # lacks memory_stats (CPU hosts)
-                                **probe.payload(),
-                                **memory_payload(),
+                            raise RecompileError(diagnosis)
+
+                try:
+                    for i in range(steps_per_epoch):
+                        if profile_window is not None:
+                            profile_window.on_step(gstep_host)
+                        fetch0 = time.perf_counter()
+                        with obs.span("data_wait", step=gstep_host):
+                            batch = next(it, None)
+                        if batch is None:
+                            break
+                        t_data = time.perf_counter() - fetch0
+                        data_time.update(t_data)
+                        probe.data_wait(t_data)
+                        t_disp0 = time.perf_counter()
+                        with obs.span("step", step=gstep_host):
+                            state, metrics = step_fn(state, batch, root_rng)
+                        probe.dispatched(time.perf_counter() - t_disp0)
+                        if probe.should_sample(gstep_host):
+                            # drain the device queue ON SAMPLED STEPS ONLY,
+                            # splitting host dispatch from device compute —
+                            # every other step stays sync-free
+                            with obs.span("device_wait", step=gstep_host):
+                                t_dev0 = time.perf_counter()
+                                jax.block_until_ready((state, metrics))
+                            probe.device_block(time.perf_counter() - t_dev0)
+                        gstep_host += 1
+                        # bounded in-flight window: wait on the OLDEST
+                        # dispatched step only — `pipeline_depth` newer
+                        # steps stay queued on the device
+                        inflight.append(metrics)
+                        if len(inflight) > pipeline_depth:
+                            jax.block_until_ready(inflight.popleft())
+                        if wd is not None:
+                            wd.beat()  # a timestamp assignment — no device sync
+                        if pending is not None:
+                            # the previous log step's metrics, fetched
+                            # with this step already queued behind them
+                            flush_log(pending)
+                            pending = None
+                        if preempted["count"]:
+                            stop_now = True
+                            break
+                        if i % config.log_every == 0 or i == steps_per_epoch - 1:
+                            pending = {
+                                "i": i, "gstep": gstep_host,
+                                "metrics": metrics, "state": state,
+                                "t_data": t_data,
                             }
-                            # fault-tolerance observability: only present
-                            # when nonzero, so clean runs keep clean lines
-                            if guard["nan_steps"]:
-                                payload["nan_steps"] = guard["nan_steps"]
-                            decode_failures = getattr(pipeline, "decode_failures", 0)
-                            if decode_failures:
-                                payload["decode_failures"] = decode_failures
-                            io_retries = retry.snapshot()
-                            if io_retries:
-                                payload["io_retries"] = io_retries
-                            if compile_monitor is not None:
-                                # always present under --strict-tracing
-                                # (not only-when-nonzero like the fault
-                                # counters): dashboards watch it for
-                                # FLATNESS, and absence would read as 0
-                                misses = compile_monitor.misses()
-                                payload["compile_cache_misses"] = misses
-                            # comms ledger: analytic per-step wire bytes
-                            # for every collective the step traced
-                            # (obs/comms.py) — static values, no syncs
-                            payload.update(comms.payload())
-                            if fleet is not None:
-                                # cross-host aggregation: EVERY process
-                                # contributes its vector (this is a
-                                # collective, keyed on the replicated
-                                # log schedule so all hosts agree);
-                                # process 0's line carries the fleet view
-                                stats = fleet.gather(
-                                    fleet.host_vector(
-                                        t_data=payload.get("t_data"),
-                                        t_step=payload.get("t_step"),
-                                        dispatch_lag=probe.last_dispatch,
-                                        io_retries=float(
-                                            sum(io_retries.values())
-                                        ) if io_retries else 0.0,
-                                        decode_failures=float(decode_failures),
-                                        hbm_live=payload.get("hbm_live_bytes"),
-                                    )
-                                )
-                                if fleet.process_index == 0:
-                                    payload.update(fleet.payload(stats))
-                            heartbeat.beat(step=gstep, epoch=epoch)
-                            writer.write(gstep, payload)
-                            if engine is not None:
-                                handle_alerts(
-                                    gstep, epoch, engine.observe(gstep, payload)
-                                )
-                            if recompile_guard is not None:
-                                diagnosis = recompile_guard.update(gstep, misses)
-                                if diagnosis is not None:
-                                    writer.write(
-                                        gstep,
-                                        {"epoch": epoch,
-                                         "event": "recompile_after_warmup",
-                                         "compile_cache_misses": misses},
-                                    )
-                                    writer.fsync()
-                                    raise RecompileError(diagnosis)
-                    end = time.perf_counter()
+                    if pending is not None and not stop_now:
+                        # the epoch's final log step has no successor
+                        # iteration — flush it here
+                        flush_log(pending)
+                        pending = None
+                finally:
+                    # epoch teardown / preemption exit: release the
+                    # prefetch producer + transfer ring (the PR-5
+                    # producer-leak fix — an abandoned iterator used to
+                    # block its daemon thread on q.put forever, pinning
+                    # the decode pool)
+                    closer = getattr(it, "close", None)
+                    if closer is not None:
+                        closer()
                 last_avg = {
                     "epoch": epoch,
                     "loss": losses.avg,

@@ -285,6 +285,21 @@ class TrainConfig:
     # Abort on any fired alert, after an emergency checkpoint (reuses
     # the fault-tolerance layer's save-first-die-second path).
     alerts_fatal: bool = False
+    # -- input wire (data/device_prefetch.py) ---------------------------
+    # Device prefetch ring: a dedicated transfer thread stages the next
+    # `prefetch_depth` batches on device (sharded uint8 device_put)
+    # while the current step runs, so decode, the wire, and compute
+    # overlap instead of taking turns (the reference hides this cost
+    # behind 32 DataLoader workers + pinned-memory async H2D). Off =
+    # the synchronous in-line path (one producer thread does decode →
+    # transfer → dispatch serially).
+    device_prefetch: bool = True
+    prefetch_depth: int = 2
+    # Donate the consumed staging slot's uint8 buffer to the augment
+    # step so XLA reuses its HBM for the normalized output instead of
+    # allocating a fresh batch-sized buffer. Ignored (harmless) on
+    # backends without donation support (CPU).
+    prefetch_donate: bool = False
 
 
 def config_to_dict(cfg: TrainConfig) -> dict:

@@ -15,6 +15,13 @@ Spec grammar: comma-separated faults, each `kind@key=val[:key=val...]`:
     io@site=S:at=K[:times=M]      raise IOError on the Kth (1-based) read
                                   at call site S (M consecutive reads;
                                   default 1) — exercises the retry layer
+    delay@site=S:seconds=X[:at=K:times=M]
+                                  sleep X seconds on calls K..K+M-1
+                                  (1-based; default: every call) at site
+                                  S — deterministic stage slow-downs
+                                  (S="input.h2d" is the synthetic slow
+                                  wire the overlap tests/smoke use;
+                                  S="data.read" slows host decode)
     nan@step=N[:times=M]          the loss observed at global steps
                                   N..N+M-1 becomes NaN — exercises the
                                   non-finite guard
@@ -41,7 +48,7 @@ import time
 from collections import Counter
 from typing import Optional
 
-KINDS = ("ckpt_truncate", "io", "nan", "stall", "preempt")
+KINDS = ("ckpt_truncate", "io", "nan", "stall", "preempt", "delay")
 
 _INT_KEYS = ("step", "at", "times")
 _FLOAT_KEYS = ("seconds",)
@@ -95,6 +102,22 @@ class FaultPlan:
             at = p.get("at", 1)
             if at <= n < at + p.get("times", 1):
                 raise IOError(f"injected fault: read #{n} at site {site!r}")
+
+    def maybe_delay(self, site: str) -> None:
+        """Deterministic per-site sleep (stage slow-down, not an error):
+        counted on its own counter namespace so io@ and delay@ rules on
+        the same site don't perturb each other's schedules."""
+        key = f"delay:{site}"
+        with self._lock:
+            self._io_counts[key] += 1
+            n = self._io_counts[key]
+        for kind, p in self.rules:
+            if kind != "delay" or p.get("site", site) != site:
+                continue
+            at = p.get("at", 1)
+            times = p.get("times")
+            if n >= at and (times is None or n < at + times):
+                time.sleep(p["seconds"])
 
     def corrupt_loss(self, loss: float, step: int) -> float:
         for kind, p in self.rules:
@@ -188,6 +211,11 @@ def describe() -> list:
 def maybe_io_error(site: str) -> None:
     if _PLAN is not None:
         _PLAN.maybe_io_error(site)
+
+
+def maybe_delay(site: str) -> None:
+    if _PLAN is not None:
+        _PLAN.maybe_delay(site)
 
 
 def corrupt_loss(loss: float, step: int) -> float:

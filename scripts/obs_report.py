@@ -150,6 +150,34 @@ def render_report(
         w("(no t_step fields — run predates the telemetry layer?)")
     w("")
 
+    # -- input wire (device prefetch ring) -------------------------------
+    t_xfer = [r["t_transfer"] for r in train_lines
+              if isinstance(r.get("t_transfer"), (int, float))]
+    if t_xfer:
+        xbytes = [r["transfer_bytes"] for r in train_lines
+                  if isinstance(r.get("transfer_bytes"), (int, float))]
+        depth = [r["prefetch_depth_live"] for r in train_lines
+                 if isinstance(r.get("prefetch_depth_live"), (int, float))]
+        mean_xfer = sum(t_xfer) / len(t_xfer)
+        w("## Input wire (device prefetch ring)")
+        w("")
+        w(f"mean transfer: {mean_xfer * 1e3:.1f} ms/batch"
+          + (f" ({sum(xbytes) / len(xbytes) / 1e6:.1f} MB -> "
+             f"{sum(xbytes) / len(xbytes) / 1e6 / max(mean_xfer, 1e-9):.0f} MB/s"
+             if xbytes else "")
+          + ")")
+        if depth:
+            starved = sum(1 for d in depth if d == 0)
+            w(f"staged depth at consume: mean {sum(depth) / len(depth):.1f}, "
+              f"empty on {starved}/{len(depth)} lines "
+              "(empty = the wire or the host is the bottleneck; "
+              "full = the device is)")
+        if t_step:
+            frac = mean_xfer / mean_step if mean_step else 0.0
+            w(f"wire/step ratio: {frac * 100:.0f}% "
+              "(>100% means transfer bounds throughput even when overlapped)")
+        w("")
+
     # -- fleet view ------------------------------------------------------
     skew = _trend(train_lines, "straggler_skew")
     hosts = [r["fleet_hosts"] for r in train_lines if isinstance(r.get("fleet_hosts"), int)]

@@ -58,6 +58,15 @@ run input_transfer 1800 -- python scripts/profile_input.py --batch 64 --n-images
 # (PROFILE.md). Expected to COMPILE FINE (it removes reduces).
 run bench_r50_eman 2700 BENCH_SKIP_DATA=1 BENCH_KEY_BN_EVAL=1 -- python bench.py
 
+# Input-wire overlap A/B (ISSUE 5 tentpole) at the anchor geometry:
+# bench.py now runs the with-data leg BOTH ways — sync iterator vs the
+# device prefetch ring — and reports with_data{,_sync} per chip plus
+# overlap_efficiency = achieved / min(host, device, wire). This is the
+# on-hardware measurement of the round-5 with-data ceiling move
+# (~288 imgs/s serial -> wire-bound ~2500 on this tunnel, device-bound
+# on a pod host). Obs-overhead leg skipped: this leg is about the wire.
+run input_overlap 2700 BENCH_SKIP_OBS_OVERHEAD=1 -- python bench.py
+
 # bn_stats_rows compile-pathology bisect (VERDICT r4 #2): small ConvBN
 # stacks, rows x variant grid, per-cell subprocess compiles timed.
 # Runs BEFORE the full-step bn32 bench legs so the diagnosis lands even

@@ -113,9 +113,16 @@ def test_collective_bytes_formulas():
     assert comms.collective_bytes("psum_scatter", b, n) == b * 7 // 8
     assert comms.collective_bytes("ppermute", b, n) == b
     assert comms.collective_bytes("broadcast", b, n) == b
-    # size-1 axis moves nothing
+    # host->device staging (the input wire): payload crosses once,
+    # whatever the axis size — including the degenerate axis of 1
+    assert comms.collective_bytes("device_put", b, n) == b
+    assert comms.collective_bytes("device_put", b, 1) == b
+    # size-1 axis moves nothing — except device_put, which is not a
+    # ring collective (the payload crosses the PCIe/DMA wire once
+    # regardless of any mesh axis)
     for kind in comms.COLLECTIVES:
-        assert comms.collective_bytes(kind, b, 1) == 0
+        if kind != "device_put":
+            assert comms.collective_bytes(kind, b, 1) == 0
     with pytest.raises(ValueError, match="unknown collective"):
         comms.collective_bytes("gossip", b, n)
 

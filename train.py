@@ -235,6 +235,26 @@ def build_parser() -> argparse.ArgumentParser:
         help="disable the in-step MoCo health gauges (EMA drift, logit "
         "stats, collapse detection, queue staleness)",
     )
+    p.add_argument(
+        "--no-device-prefetch", dest="device_prefetch", action="store_false",
+        default=None,
+        help="disable the device prefetch ring (data/device_prefetch.py) "
+        "and fall back to the synchronous input path — decode, host→"
+        "device transfer, and compute take turns instead of overlapping",
+    )
+    p.add_argument(
+        "--prefetch-depth", type=int, default=None,
+        help="device prefetch ring depth: batches staged on device ahead "
+        "of the step loop, and the in-flight step window (default 2; "
+        "raise on hosts whose wire is bursty, at ~2 batch-pairs of HBM "
+        "per slot)",
+    )
+    p.add_argument(
+        "--prefetch-donate", action="store_true", default=None,
+        help="donate the consumed staging slot's uint8 buffer to the "
+        "augment step (XLA reuses its HBM for the normalized output); "
+        "ignored on backends without donation",
+    )
     return p
 
 
@@ -315,6 +335,9 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         fleet_metrics=args.fleet_metrics,
         alert_rules=args.alert_rules,
         alerts_fatal=args.alerts_fatal,
+        device_prefetch=args.device_prefetch,
+        prefetch_depth=args.prefetch_depth,
+        prefetch_donate=args.prefetch_donate,
     )
 
 
