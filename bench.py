@@ -105,19 +105,44 @@ def _ensure_jpeg_folder(root: str, n: int, size: int, classes: int = 8) -> str:
 
 def main() -> None:
     from moco_tpu.utils.platform import (
-        backend_usable,
+        backend_probe,
         enable_persistent_compilation_cache,
         pin_platform_from_env,
     )
 
+    # Per-leg skip ledger (BENCH r02–r05 lesson: the bench silently
+    # degraded to the CPU smoke for four rounds and nobody could say
+    # why from the JSON alone). Every leg records ran/skip_reason; the
+    # ledger ships inside the one-line JSON as `legs`.
+    legs: dict[str, dict] = {
+        name: {"ran": False, "skip_reason": None}
+        for name in ("accelerator", "numerics_crosscheck", "obs_overhead", "with_data")
+    }
+
+    def _skip(leg: str, reason: str) -> None:
+        legs[leg]["skip_reason"] = reason
+        print(f"leg {leg} skipped: {reason}", file=sys.stderr)
+
     pin_platform_from_env()  # honor an explicit JAX_PLATFORMS request
     # A bench that crashes or hangs on a down/wedged tunnel emits NO
     # metric line at all — degrading to the CPU smoke is strictly better.
-    if not backend_usable():
-        print("accelerator backend unavailable/hung; CPU fallback", file=sys.stderr)
-        jax.config.update("jax_platforms", "cpu")
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        _skip("accelerator", "JAX_PLATFORMS=cpu pinned by the environment")
+    else:
+        usable, probe_reason = backend_probe()
+        if not usable:
+            print("accelerator backend unavailable/hung; CPU fallback", file=sys.stderr)
+            _skip("accelerator", probe_reason or "backend probe failed")
+            jax.config.update("jax_platforms", "cpu")
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
+    if on_tpu:
+        legs["accelerator"]["ran"] = True
+    elif legs["accelerator"]["skip_reason"] is None:
+        _skip(
+            "accelerator",
+            f"default backend is {platform!r}, not TPU (no probe failure)",
+        )
     if on_tpu:
         # AFTER the fallback decision on purpose: the degraded CPU smoke
         # must not write XLA:CPU AOT entries (see the guard's docstring)
@@ -237,11 +262,16 @@ def main() -> None:
     # on-chip correctness evidence without needing the pytest session.
     # Opt-in (two extra full-step compiles, ~2×3.5 min on the chip).
     crosscheck_ok = True
+    if os.environ.get("BENCH_NUMERICS") != "1":
+        _skip("numerics_crosscheck", "opt-in leg (set BENCH_NUMERICS=1; two extra full-step compiles)")
+    elif is_vit or moco.num_negatives == 0:
+        _skip("numerics_crosscheck", "fused-vs-dense InfoNCE A/B needs the queue-based (non-ViT) step")
     if (
         os.environ.get("BENCH_NUMERICS") == "1"
         and not is_vit
         and moco.num_negatives > 0
     ):
+        legs["numerics_crosscheck"]["ran"] = True
         import dataclasses
 
         outs = {}
@@ -311,7 +341,9 @@ def main() -> None:
     # observability costs so a regression in the telemetry layer is a
     # visible number, not a silent throughput tax.
     obs_overhead_pct = None
-    if not os.environ.get("BENCH_SKIP_OBS_OVERHEAD"):
+    if os.environ.get("BENCH_SKIP_OBS_OVERHEAD"):
+        _skip("obs_overhead", "BENCH_SKIP_OBS_OVERHEAD set")
+    else:
         try:
             import dataclasses as _dc
             import tempfile as _tf
@@ -348,13 +380,14 @@ def main() -> None:
             dt_bare = _timed_leg(step_bare)
             if dt_bare > 0:
                 obs_overhead_pct = round((dt_full - dt_bare) / dt_bare * 100.0, 2)
+            legs["obs_overhead"]["ran"] = True
             print(
                 f"obs overhead: full={dt_full:.2f}s bare={dt_bare:.2f}s "
                 f"-> {obs_overhead_pct}%",
                 file=sys.stderr,
             )
         except Exception as e:
-            print(f"obs-overhead bench failed: {e}", file=sys.stderr)
+            _skip("obs_overhead", f"leg crashed: {e!r:.200}")
 
     # ---- MFU (per-device FLOPs over per-device peak) ------------------
     flops_per_dev = _step_flops(step, state, batch_dict, root_rng) or (
@@ -376,7 +409,9 @@ def main() -> None:
     # series and an overlap A/B even when the TPU tunnel is down
     # (BENCH_r05.json carried `with_data: null` for exactly that reason).
     with_data = with_data_sync = overlap_efficiency = None
-    if not os.environ.get("BENCH_SKIP_DATA"):
+    if os.environ.get("BENCH_SKIP_DATA"):
+        _skip("with_data", "BENCH_SKIP_DATA set")
+    else:
         try:
             from moco_tpu.data.pipeline import TwoCropPipeline
 
@@ -465,6 +500,7 @@ def main() -> None:
                 if wire_bps and bytes_per_img:
                     bounds.append(wire_bps / bytes_per_img)
             overlap_efficiency = over_rate / min(bounds)
+            legs["with_data"]["ran"] = True
             print(
                 f"with-data: sync={sync_rate:.1f} overlapped={over_rate:.1f} imgs/s "
                 f"(bounds host={host_rate:.1f} device={imgs_per_sec:.1f}"
@@ -473,7 +509,7 @@ def main() -> None:
                 file=sys.stderr,
             )
         except Exception as e:
-            print(f"with-data bench failed: {e}", file=sys.stderr)
+            _skip("with_data", f"leg crashed: {e!r:.200}")
 
     print(
         f"platform={platform} chips={n_dev} arch={arch} batch={batch} "
@@ -519,6 +555,11 @@ def main() -> None:
                 # telemetry-layer cost: full obs (health gauges + tracer
                 # + sink writes) vs bare, same compiled shapes
                 "obs_overhead_pct": obs_overhead_pct,
+                # per-leg skip ledger: WHY a leg didn't run, in-band —
+                # a BENCH_*.json degraded to the CPU smoke now says so
+                # itself (accelerator.skip_reason) instead of relying on
+                # someone reading four rounds of stderr
+                "legs": legs,
             }
         )
     )

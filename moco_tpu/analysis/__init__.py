@@ -3,10 +3,10 @@
 The invariants MoCo correctness and TPU throughput hang on are invisible
 to Python's type system: the key encoder must only move via EMA under
 `stop_gradient` (He et al., arXiv:1911.05722), PRNG keys must never be
-consumed twice, and the jitted hot path must contain zero host
-round-trips and zero recompile hazards — a stray `float(loss)` inside
-the step burns an hour of TPU time before anyone notices. `mocolint`
-checks these *before* the run:
+consumed twice, the jitted hot path must contain zero host round-trips
+and zero recompile hazards, and — the pod-scale one — every host must
+issue the SAME collectives in the SAME order or the fleet deadlocks
+silently. `mocolint` checks these *before* the run:
 
 ====  =========================================================
 Rule  Checks
@@ -14,34 +14,78 @@ Rule  Checks
 JX001 impure calls (`time.*`, stdlib `random.*`, `print`, `global`
       mutation) inside jit/shard_map-compiled functions
 JX002 implicit host transfer on traced values (`float()`, `int()`,
-      `bool()`, `np.asarray`, `.item()`) inside jitted scope
+      `bool()`, `np.asarray`, `.item()`) inside jitted scope —
+      interprocedural: jitted scope closes over resolved calls
+      ACROSS modules
 JX003 PRNG key reuse — one key consumed by two samplers without an
-      interleaving `split`/`fold_in`
+      interleaving `split`/`fold_in`; helper calls resolve through
+      dataflow summaries (a pure fold_in wrapper is not a use)
 JX004 recompile hazards — non-hashable literals in static args,
       `static_argnames` not in the wrapped signature, Python
       branching on `.shape` inside jitted scope
 JX005 key-encoder/queue tensors reaching a loss without
-      `stop_gradient` (the MoCo invariant; `ops/losses.py:36` and
-      `core/queue.py:37` are the known-good sanitizing patterns)
+      `stop_gradient` (the MoCo invariant) — interprocedural:
+      taint crosses helper returns, summary-proven sanitizers
+      clean, and a tainted argument handed to a helper whose
+      parameter reaches an einsum/cross_entropy inside fires at
+      the call site
 JX006 `donate_argnums` buffers read again after the jitted call
 JX007 collective axis names inconsistent with the enclosing
-      `shard_map`/`pmap` axis declaration
+      `shard_map`/`pmap` axis declaration (lexical)
+JX008 SPMD divergence — a collective issued under HOST-LOCAL
+      control flow (`process_index`, wall clock, per-host retry/
+      decode counters, exception handlers): the silent-pod-
+      deadlock bug class
+JX009 mixed-precision hazards — bf16/f16 operands reaching
+      matmul/einsum/`@`/psum without `preferred_element_type=`
+      f32 accumulation (or a cast up before the reduction)
+JX010 interprocedural sharding consistency — a HELPER-issued
+      collective (resolved through call-site axis bindings, JX007
+      generalized across functions and modules) naming an axis
+      the enclosing shard_map does not declare
+JX011 input-wire thread hygiene — threads started without
+      join-on-close; blocking `put` on a bounded queue with no
+      poison-pill/timeout path (the PR-5 producer-leak shape)
 ====  =========================================================
+
+Since v2 the engine is a real analysis stack: `analysis/callgraph.py`
+builds a whole-program call graph (module + method resolution across
+every analyzed file) and `analysis/dataflow.py` computes per-function
+summaries (taint propagation, sanitization, PRNG consumption,
+host-local returns, transitive collectives with axis bindings) to a
+fixpoint — so the rules above follow values across function and module
+boundaries instead of stopping at the `def`.
 
 Usage::
 
-    python -m moco_tpu.analysis moco_tpu/ scripts/ train.py
+    python -m moco_tpu.analysis moco_tpu/ scripts/ tests/ train.py
     python -m moco_tpu.analysis moco_tpu/ --format json -o report.json
 
-Suppress a finding on its line with a justification::
+Suppress a finding with a justification — the comment may sit on ANY
+line of the statement, including the closing line of a multi-line
+call::
 
     x = balanced_unshuffle(rng, y)  # mocolint: disable=JX003  (involution reuses the key on purpose)
 
-The runtime arm (`moco_tpu/analysis/runtime.py`) complements the static
-pass inside the train driver: `--strict-tracing` turns on
-`jax.check_tracer_leaks`, surfaces a `compile_cache_misses` counter on
-every metrics.jsonl log line, and aborts when the step function
-recompiles after warm-up.
+Baselines gate incremental rule rollout: ``--update-baseline`` writes
+`mocolint-baseline.json` fingerprinting today's findings; later runs
+auto-discover it (walking up from the analyzed paths; ``--no-baseline``
+opts out) and fail only on NEW findings. CI lints `tests/` this way —
+the lint fixtures' intentional findings live in the baseline.
+
+The runtime arm complements the static pass inside the train driver:
+
+- `--strict-tracing` (`analysis/runtime.py`): `jax.check_tracer_leaks`,
+  a `compile_cache_misses` counter on every metrics.jsonl log line, and
+  abort-on-recompile-after-warm-up;
+- `--sanitize-collectives` (`analysis/sanitizer.py`): every
+  `obs/comms.py`-tagged collective site records its (site, kind,
+  operand-shape) into the process's traced schedule; log steps publish
+  the schedule hash out-of-band (`schedule.p<i>.json`) and cross-check
+  every peer, aborting with a per-site diff — and a
+  `collective_schedule_hash` metrics field — BEFORE a schedule mismatch
+  can deadlock the pod. `diverge@site=S` (`utils/faults.py`) injects a
+  deterministic divergence for CI (`scripts/sanitizer_smoke.py`).
 """
 
 from __future__ import annotations
@@ -51,8 +95,10 @@ from moco_tpu.analysis.engine import (
     analyze_paths,
     analyze_source,
     iter_rules,
+    load_baseline,
     render_json,
     render_text,
+    write_baseline,
 )
 
 __all__ = [
@@ -60,6 +106,8 @@ __all__ = [
     "analyze_paths",
     "analyze_source",
     "iter_rules",
+    "load_baseline",
     "render_json",
     "render_text",
+    "write_baseline",
 ]

@@ -1,8 +1,19 @@
 """CLI: ``python -m moco_tpu.analysis [paths...]`` (a.k.a. mocolint).
 
-Exit status 0 when every finding is suppressed (or none exist), 1 when
-unsuppressed findings remain, 2 on usage errors — so CI can block on it
-directly.
+Exit status 0 when every finding is suppressed or baselined (or none
+exist), 1 when new findings remain, 2 on usage errors — so CI can block
+on it directly.
+
+Baseline workflow (incremental rule rollout)::
+
+    # record today's findings (e.g. the lint fixtures under tests/)
+    python -m moco_tpu.analysis moco_tpu/ scripts/ tests/ train.py --update-baseline
+    # later runs auto-discover mocolint-baseline.json walking up from
+    # the analyzed paths and fail only on findings NOT in it
+    python -m moco_tpu.analysis moco_tpu/ scripts/ tests/ train.py
+    # explicit control
+    python -m moco_tpu.analysis tests/ --baseline mocolint-baseline.json
+    python -m moco_tpu.analysis tests/ --no-baseline
 """
 
 from __future__ import annotations
@@ -12,9 +23,12 @@ import sys
 
 from moco_tpu.analysis.engine import (
     analyze_paths,
+    discover_baseline,
     iter_rules,
+    load_baseline,
     render_json,
     render_text,
+    write_baseline,
 )
 
 
@@ -23,7 +37,9 @@ def build_parser() -> argparse.ArgumentParser:
         prog="mocolint",
         description="JAX/TPU-aware static analysis for moco-tpu "
         "(impure jitted code, host transfers, PRNG reuse, recompile "
-        "hazards, stop_gradient invariants, donation bugs, axis names)",
+        "hazards, stop_gradient invariants, donation bugs, axis names, "
+        "SPMD divergence, mixed-precision hazards, sharding consistency, "
+        "input-wire thread hygiene — interprocedural since v2)",
     )
     p.add_argument("paths", nargs="*", default=["moco_tpu"], help="files or directories")
     p.add_argument("--format", choices=("text", "json"), default="text")
@@ -34,9 +50,23 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--show-suppressed", action="store_true",
-        help="include suppressed findings in text output",
+        help="include suppressed/baselined findings in text output",
     )
     p.add_argument("--list-rules", action="store_true")
+    p.add_argument(
+        "--baseline", default=None, metavar="FILE",
+        help="findings baseline to accept (default: auto-discover "
+        "mocolint-baseline.json walking up from the analyzed paths)",
+    )
+    p.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore any baseline, including an auto-discovered one",
+    )
+    p.add_argument(
+        "--update-baseline", action="store_true",
+        help="(re)write the baseline file from this run's findings "
+        "instead of failing on them",
+    )
     return p
 
 
@@ -54,7 +84,25 @@ def main(argv: list[str] | None = None) -> int:
         if unknown:
             print(f"mocolint: unknown rule(s): {', '.join(sorted(unknown))}", file=sys.stderr)
             return 2
-    findings = analyze_paths(args.paths, rules=rules)
+    baseline_path = None
+    if not args.no_baseline:
+        baseline_path = args.baseline or discover_baseline(args.paths)
+    if args.update_baseline:
+        findings = analyze_paths(args.paths, rules=rules)
+        from moco_tpu.analysis.engine import BASELINE_FILENAME
+
+        target = args.baseline or baseline_path or BASELINE_FILENAME
+        n = write_baseline(target, findings)
+        print(f"mocolint: baseline written to {target} ({n} fingerprint(s))")
+        return 0
+    baseline = None
+    if baseline_path is not None:
+        try:
+            baseline = load_baseline(baseline_path)
+        except (OSError, ValueError) as e:
+            print(f"mocolint: cannot read baseline {baseline_path}: {e}", file=sys.stderr)
+            return 2
+    findings = analyze_paths(args.paths, rules=rules, baseline=baseline)
     report = (
         render_json(findings)
         if args.format == "json"
@@ -65,7 +113,7 @@ def main(argv: list[str] | None = None) -> int:
             fh.write(report + "\n")
     if args.format == "text" or not args.output:
         print(report)
-    return 1 if any(not f.suppressed for f in findings) else 0
+    return 1 if any(f.active for f in findings) else 0
 
 
 if __name__ == "__main__":

@@ -96,6 +96,10 @@ class ModuleContext:
         self.tree = tree
         self.path = path
         self.source_lines = source.splitlines()
+        # whole-program backrefs, attached by analysis.callgraph when the
+        # engine analyzes a file SET; None for a lone-module analysis
+        self.program = None
+        self.module_name: Optional[str] = None
         self.imports = collect_imports(tree)
         self.functions: list[ast.FunctionDef] = [
             n

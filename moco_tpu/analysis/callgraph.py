@@ -1,0 +1,277 @@
+"""Whole-program call graph for mocolint's interprocedural passes.
+
+The per-function rules (JX001–JX007) go blind exactly where MoCo's
+correctness chain lives: `stop_gradient` is applied in `ops/losses.py`,
+the taint originates in `core/moco.py`, and the collective whose axis
+must agree with the `shard_map` declaration sits two helper calls away
+in `parallel/shuffle.py`. This module resolves module-level functions
+and methods ACROSS the analyzed file set so the dataflow engine
+(`analysis/dataflow.py`) can push taint and summaries through call
+sites.
+
+Resolution is deliberately approximate (same contract as `astutils`:
+high-value findings, near-zero false positives — unresolvable calls
+stay unresolved, they never guess):
+
+- module names derive from file paths (`moco_tpu/parallel/shuffle.py`
+  -> ``moco_tpu.parallel.shuffle``), anchored at the shallowest
+  directory that makes every analyzed file addressable;
+- a call's dotted qualname resolves through the caller module's import
+  aliases (`from moco_tpu.core.queue import enqueue` / ``import
+  moco_tpu.core.queue as q``), then matches module-level functions and
+  ``Class.method`` definitions in the analyzed set;
+- ``self.method()`` resolves within the enclosing class;
+- anything else (attribute chains on locals, higher-order values,
+  foreign libraries) resolves to None.
+
+Everything here is stdlib-only: the analyzer must run in CI with no
+heavy deps installed (`pip install -e . --no-deps`).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Iterator, Optional
+
+from moco_tpu.analysis.astutils import (
+    ModuleContext,
+    decorator_qual,
+    jit_kind,
+    qualname,
+)
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    """One function or method definition in the analyzed program."""
+
+    qualname: str  # "pkg.mod.fn" or "pkg.mod.Class.fn"
+    module: str  # "pkg.mod"
+    node: ast.FunctionDef
+    ctx: ModuleContext
+    cls: Optional[str] = None  # enclosing class name, None for module level
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+    def param_names(self) -> list[str]:
+        a = self.node.args
+        return [p.arg for p in [*a.posonlyargs, *a.args, *a.kwonlyargs]]
+
+
+def module_name_for(path: str, roots: Optional[list[str]] = None) -> str:
+    """Dotted module name from a file path. `roots` are directory
+    prefixes to strip (the analyzed tree's anchor points); without one
+    that matches, the path's components become the name as-is."""
+    norm = os.path.normpath(path)
+    for root in roots or []:
+        r = os.path.normpath(root)
+        if norm.startswith(r + os.sep):
+            norm = norm[len(r) + 1 :]
+            break
+    if norm.endswith(".py"):
+        norm = norm[: -len(".py")]
+    parts = [p for p in norm.split(os.sep) if p not in ("", ".")]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _enclosing_classes(tree: ast.Module) -> dict[int, str]:
+    """id(FunctionDef) -> immediate enclosing class name (one level)."""
+    out: dict[int, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            for child in node.body:
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    out[id(child)] = node.name
+    return out
+
+
+class Program:
+    """The analyzed file set as one unit: modules, functions, call graph.
+
+    Built once per `analyze_paths` run and attached to every
+    `ModuleContext` as ``ctx.program``; rules degrade to per-module
+    behavior when it is absent (`analyze_source` on a lone string still
+    builds a single-file Program, so cross-FUNCTION flows inside one
+    file resolve either way).
+    """
+
+    def __init__(self, contexts: dict[str, ModuleContext]):
+        # path -> ctx; module -> ctx; qualname -> FunctionInfo
+        self.contexts = contexts
+        roots = self._infer_roots(list(contexts))
+        self.module_of_path: dict[str, str] = {
+            path: module_name_for(path, roots) for path in contexts
+        }
+        self.by_module: dict[str, ModuleContext] = {
+            self.module_of_path[path]: ctx for path, ctx in contexts.items()
+        }
+        self.functions: dict[str, FunctionInfo] = {}
+        for path, ctx in contexts.items():
+            module = self.module_of_path[path]
+            ctx.module_name = module
+            classes = _enclosing_classes(ctx.tree)
+            for fn in ctx.functions:
+                cls = classes.get(id(fn))
+                qual = f"{module}.{cls}.{fn.name}" if cls else f"{module}.{fn.name}"
+                # later definition wins on duplicates, like runtime rebinding
+                self.functions[qual] = FunctionInfo(
+                    qualname=qual, module=module, node=fn, ctx=ctx, cls=cls
+                )
+        self._by_node: dict[int, FunctionInfo] = {
+            id(info.node): info for info in self.functions.values()
+        }
+        self._edges: Optional[dict[str, set[str]]] = None
+        self._jitted: Optional[set[str]] = None
+
+    # -- construction helpers -------------------------------------------
+
+    @staticmethod
+    def _infer_roots(paths: list[str]) -> list[str]:
+        """Anchor directories so `moco_tpu/...` paths produce importable
+        dotted names whether the analyzer runs from the repo root or is
+        handed absolute paths."""
+        roots: set[str] = set()
+        for p in paths:
+            norm = os.path.normpath(p)
+            parts = norm.split(os.sep)
+            for anchor in ("moco_tpu", "scripts", "tests"):
+                if anchor in parts:
+                    idx = parts.index(anchor)
+                    if idx > 0:
+                        roots.add(os.sep.join(parts[:idx]))
+                    break
+            else:
+                d = os.path.dirname(norm)
+                if d:
+                    roots.add(d)
+        # longest first: the most specific anchor strips the most
+        return sorted(roots, key=len, reverse=True)
+
+    # -- lookups ---------------------------------------------------------
+
+    def info_for_node(self, fn: ast.FunctionDef) -> Optional[FunctionInfo]:
+        return self._by_node.get(id(fn))
+
+    def lookup(self, dotted: str) -> Optional[FunctionInfo]:
+        """FunctionInfo for a dotted origin, trying `mod.fn` then
+        `mod.Class.fn` (an import of a class followed by `.method`)."""
+        return self.functions.get(dotted)
+
+    def resolve_call(
+        self, ctx: ModuleContext, call: ast.Call, enclosing: Optional[ast.FunctionDef] = None
+    ) -> Optional[FunctionInfo]:
+        """Resolve a call expression to a definition in the program."""
+        func = call.func
+        # self.method() -> method of the enclosing class
+        if (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "self"
+            and enclosing is not None
+        ):
+            info = self.info_for_node(enclosing)
+            if info is not None and info.cls is not None:
+                return self.functions.get(f"{info.module}.{info.cls}.{func.attr}")
+            return None
+        qual = qualname(func, ctx.imports)
+        if qual is None:
+            return None
+        hit = self.functions.get(qual)
+        if hit is not None:
+            return hit
+        # bare local name -> this module's function
+        if isinstance(func, ast.Name):
+            module = self.module_of(ctx)
+            if module is not None:
+                return self.functions.get(f"{module}.{func.id}")
+        return None
+
+    def module_of(self, ctx: ModuleContext) -> Optional[str]:
+        return getattr(ctx, "module_name", None)
+
+    # -- call graph ------------------------------------------------------
+
+    def calls_in(self, info: FunctionInfo) -> Iterator[tuple[ast.Call, Optional[FunctionInfo]]]:
+        """(call node, resolved callee or None) for every call in the
+        function's own body (nested defs belong to themselves)."""
+        from moco_tpu.analysis.astutils import walk_own
+
+        for node in walk_own(info.node):
+            if isinstance(node, ast.Call):
+                yield node, self.resolve_call(info.ctx, node, enclosing=info.node)
+
+    def edges(self) -> dict[str, set[str]]:
+        """caller qualname -> {callee qualnames} over the whole program."""
+        if self._edges is None:
+            self._edges = {}
+            for qual, info in self.functions.items():
+                outs: set[str] = set()
+                for _, callee in self.calls_in(info):
+                    if callee is not None:
+                        outs.add(callee.qualname)
+                self._edges[qual] = outs
+        return self._edges
+
+    def callees_transitive(self, qual: str, limit: int = 200) -> set[str]:
+        """All functions reachable from `qual` through resolved calls."""
+        edges = self.edges()
+        seen: set[str] = set()
+        stack = [qual]
+        while stack and len(seen) < limit:
+            cur = stack.pop()
+            for nxt in edges.get(cur, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append(nxt)
+        return seen
+
+    # -- cross-module jitted closure --------------------------------------
+
+    def jitted(self) -> set[str]:
+        """Qualnames of every function compiled by jit/shard_map/pmap,
+        closed over RESOLVED call edges program-wide — the cross-module
+        generalization of `ModuleContext.jitted` (a helper in
+        `ops/losses.py` called from the jitted step in `core/moco.py` is
+        in jitted scope even though its own module never mentions jit)."""
+        if self._jitted is not None:
+            return self._jitted
+        roots: set[str] = set()
+        for ctx in self.by_module.values():
+            for fn in ctx.jitted:
+                info = self.info_for_node(fn)
+                if info is not None:
+                    roots.add(info.qualname)
+        # also: decorated defs anywhere (defensive; ctx.jitted covers it)
+        for qual, info in self.functions.items():
+            for dec in info.node.decorator_list:
+                if jit_kind(decorator_qual(dec, info.ctx.imports)):
+                    roots.add(qual)
+        closed = set(roots)
+        stack = list(roots)
+        edges = self.edges()
+        while stack:
+            cur = stack.pop()
+            for nxt in edges.get(cur, ()):
+                if nxt not in closed:
+                    closed.add(nxt)
+                    stack.append(nxt)
+        self._jitted = closed
+        return closed
+
+    def in_jitted_scope(self, fn: ast.FunctionDef) -> bool:
+        info = self.info_for_node(fn)
+        return info is not None and info.qualname in self.jitted()
+
+
+def build_program(contexts: dict[str, ModuleContext]) -> Program:
+    """Construct and attach: every ctx gains a ``.program`` backref."""
+    program = Program(contexts)
+    for ctx in contexts.values():
+        ctx.program = program
+    return program

@@ -1,0 +1,482 @@
+"""Interprocedural dataflow summaries over the mocolint call graph.
+
+One summary per analyzed function, computed to a fixpoint so chains of
+helpers compose (`encode -> project -> einsum` three modules deep). A
+summary answers, WITHOUT re-walking the callee at every call site:
+
+- key-encoder taint (JX005): does the return value carry taint fed in
+  through a parameter (`returns_taint_of`)? is the return intrinsically
+  tainted (reads `params_k`/`batch_stats_k`/`queue` attributes itself)?
+  does the function sanitize (route its result through `stop_gradient`
+  or a known sanitizing helper)? which parameters reach a loss sink
+  (matmul/einsum/cross_entropy) inside it unsanitized (`param_sinks`)?
+- PRNG discipline (JX003): which rng-shaped parameters does the body
+  actually CONSUME (pass to a sampler), as opposed to merely deriving
+  children via `fold_in`/`split`-and-return — a pure derivation helper
+  must not count as a use at its call sites;
+- host-local values (JX008): does the return value depend on this
+  process's identity or wall clock (`process_index`, `time.*`,
+  `socket.gethostname`, `os.environ`, retry/decode counters)?
+- collectives (JX008/JX010): which collectives does the function issue,
+  directly or transitively, and through which axis expressions —
+  including collectives whose axis is one of the function's OWN
+  parameters, so a call site can bind the axis and the checker can
+  compare it against the enclosing `shard_map` declaration.
+
+The fixpoint is monotone over finite sets and bounded (`MAX_PASSES`),
+so it terminates even on recursive call graphs; an unresolved call is
+treated as the most permissive thing the rule can afford: it neither
+taints nor sanitizes.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Optional
+
+from moco_tpu.analysis.astutils import ModuleContext, walk_own
+from moco_tpu.analysis.callgraph import FunctionInfo, Program
+
+MAX_PASSES = 6
+
+# -- key-encoder taint (JX005 vocabulary, shared with the rule) -----------
+TAINT_ATTRS = {"params_k", "batch_stats_k", "queue"}
+TAINT_PARAMS = {"params_k", "batch_stats_k", "queue"}
+SANITIZER_NAMES = ("stop_gradient", "infonce_logits", "enqueue", "fused_infonce_loss")
+
+# -- loss sinks ------------------------------------------------------------
+SINK_EINSUM = "einsum"
+SINK_MATMUL = "matmul"
+SINK_XENT = "cross_entropy"
+
+# -- PRNG vocabulary (JX003, shared) --------------------------------------
+RNG_PARAM = re.compile(r"(^|_)rng(_|\d|$)|(^|_)prng(_|\d|$)|(^|_)key(_|\d|$)")
+PRNG_DERIVE = {
+    "jax.random.split",
+    "jax.random.fold_in",
+    "jax.random.clone",
+    "jax.random.PRNGKey",
+    "jax.random.key",
+}
+
+# -- host-local sources (JX008 vocabulary, shared with the rule) ----------
+HOST_LOCAL_CALLS = (
+    "process_index",  # jax.process_index and any *.process_index
+    "getpid",
+    "gethostname",
+    "perf_counter",
+    "monotonic",
+    "time.time",
+    "getenv",
+)
+HOST_LOCAL_PREFIXES = ("time.", "random.", "os.environ", "psutil.")
+HOST_LOCAL_NAMES = re.compile(
+    r"(^|_)(io_retries|decode_failures|heartbeat|retries|hostname|preempted)(_|$)"
+)
+
+# -- collectives (JX007/JX008/JX010 vocabulary) ---------------------------
+COLLECTIVES_AXIS_ARG1 = {
+    "psum", "pmean", "pmax", "pmin", "all_gather", "psum_scatter",
+    "all_to_all", "ppermute", "pshuffle",
+}
+
+
+def basename(qual: Optional[str]) -> str:
+    return (qual or "").rsplit(".", 1)[-1]
+
+
+def is_sanitizer_qual(qual: Optional[str]) -> bool:
+    if not qual:
+        return False
+    return qual in SANITIZER_NAMES or qual.endswith(
+        tuple("." + s for s in SANITIZER_NAMES)
+    )
+
+
+def is_host_local_qual(qual: Optional[str]) -> bool:
+    if not qual:
+        return False
+    if any(qual == p.rstrip(".") or qual.startswith(p) for p in HOST_LOCAL_PREFIXES):
+        return True
+    base = basename(qual)
+    for marker in HOST_LOCAL_CALLS:
+        if "." in marker:
+            if qual == marker or qual.endswith("." + marker):
+                return True
+        elif base == marker:
+            return True
+    return False
+
+
+@dataclasses.dataclass
+class CollectiveUse:
+    """One collective call inside a function body. `axis_param` is set
+    when the axis expression is (or contains only) one of the function's
+    own parameters — the caller binds it; `axis_tokens` carries literal/
+    constant tokens resolved in the defining module."""
+
+    kind: str  # psum / all_gather / ...
+    lineno: int
+    axis_tokens: frozenset[str]
+    axis_param: Optional[str] = None
+    via: Optional[str] = None  # qualname of the callee that issues it, for
+    # transitive uses surfaced at a call site
+
+
+@dataclasses.dataclass
+class Summary:
+    """Interprocedural facts about one function (see module docstring)."""
+
+    qualname: str
+    # key taint
+    returns_taint_of: set[str] = dataclasses.field(default_factory=set)
+    returns_tainted: bool = False  # intrinsic (reads tainted attrs itself)
+    sanitizes: bool = False
+    param_sinks: dict[str, str] = dataclasses.field(default_factory=dict)
+    # prng
+    consumes_rng_params: set[str] = dataclasses.field(default_factory=set)
+    derives_only_rng_params: set[str] = dataclasses.field(default_factory=set)
+    # host-local
+    returns_host_local: bool = False
+    # collectives issued here or below
+    collectives: list[CollectiveUse] = dataclasses.field(default_factory=list)
+
+    def key(self) -> tuple:
+        return (
+            frozenset(self.returns_taint_of),
+            self.returns_tainted,
+            self.sanitizes,
+            tuple(sorted(self.param_sinks.items())),
+            frozenset(self.consumes_rng_params),
+            frozenset(self.derives_only_rng_params),
+            self.returns_host_local,
+            len(self.collectives),
+        )
+
+
+def _axis_expr_of(ctx: ModuleContext, call: ast.Call) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == "axis_name":
+            return kw.value
+    base = basename(ctx.qual(call.func))
+    if base in COLLECTIVES_AXIS_ARG1 and len(call.args) >= 2:
+        return call.args[1]
+    if base in ("axis_index", "axis_size") and call.args:
+        return call.args[0]
+    return None
+
+
+def _axis_tokens(ctx: ModuleContext, expr: ast.AST) -> frozenset[str]:
+    """String tokens an axis expression can denote: literals, module
+    string constants, and constants IMPORTED from another analyzed
+    module (`from parallel.mesh import DATA_AXIS` resolves to "data")."""
+    tokens: set[str] = set()
+    prog = getattr(ctx, "program", None)
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            tokens.add(n.value)
+        elif isinstance(n, ast.Name):
+            if n.id in ctx.constants:
+                tokens.add(ctx.constants[n.id])
+            elif prog is not None and n.id in ctx.imports:
+                origin = ctx.imports[n.id]
+                mod, _, const = origin.rpartition(".")
+                other = prog.by_module.get(mod)
+                if other is not None and const in other.constants:
+                    tokens.add(other.constants[const])
+    return frozenset(tokens)
+
+
+class SummaryTable:
+    """qualname -> Summary, computed to a fixpoint over the call graph."""
+
+    def __init__(self, program: Program):
+        self.program = program
+        self.summaries: dict[str, Summary] = {}
+        for qual, info in program.functions.items():
+            s = Summary(qualname=qual)
+            s._param_names = info.param_names()  # type: ignore[attr-defined]
+            self.summaries[qual] = s
+        self._compute()
+
+    def get(self, qual: Optional[str]) -> Optional[Summary]:
+        if qual is None:
+            return None
+        return self.summaries.get(qual)
+
+    def for_call(
+        self, ctx: ModuleContext, call: ast.Call, enclosing: Optional[ast.FunctionDef]
+    ) -> Optional[Summary]:
+        info = self.program.resolve_call(ctx, call, enclosing=enclosing)
+        return None if info is None else self.summaries.get(info.qualname)
+
+    # -- fixpoint ---------------------------------------------------------
+
+    def _compute(self) -> None:
+        for _ in range(MAX_PASSES):
+            changed = False
+            for qual, info in self.program.functions.items():
+                new = self._summarize(info)
+                new._param_names = info.param_names()  # type: ignore[attr-defined]
+                if new.key() != self.summaries[qual].key():
+                    changed = True
+                self.summaries[qual] = new
+            if not changed:
+                break
+
+    # -- one function, using current callee summaries ---------------------
+
+    def _summarize(self, info: FunctionInfo) -> Summary:
+        fn, ctx = info.node, info.ctx
+        s = Summary(qualname=info.qualname)
+        params = set(info.param_names())
+        rng_params = {p for p in params if RNG_PARAM.search(p)}
+
+        # Data DEPENDENCE, not taint: name -> set of params it derives
+        # from ("*" = derives from a tainted attribute read like
+        # state.params_k). Every param seeds its own set — whether a
+        # dependence is dangerous is the CALLER's call (it knows which
+        # arguments were tainted); sanitization cuts the edge here.
+        deps: dict[str, set[str]] = {p: {p} for p in params}
+        host_names: set[str] = {
+            p for p in params if HOST_LOCAL_NAMES.search(p)
+        }
+
+        def expr_deps(expr: ast.AST) -> set[str]:
+            """Param origins an expression's value derives from; empty
+            when the expression routes through a sanitizer."""
+            if self._expr_sanitized(ctx, expr, info):
+                return set()
+            out: set[str] = set()
+            for n in ast.walk(expr):
+                if isinstance(n, ast.Name) and n.id in deps:
+                    out |= deps[n.id]
+                elif isinstance(n, ast.Attribute) and n.attr in TAINT_ATTRS:
+                    out.add("*")
+                elif isinstance(n, ast.Call):
+                    cs = self.for_call(ctx, n, fn)
+                    if cs is not None:
+                        if cs.sanitizes:
+                            continue
+                        if cs.returns_tainted:
+                            out.add("*")
+                        names = self._callee_params(cs)
+                        for i, arg in enumerate(n.args):
+                            if i < len(names) and names[i] in cs.returns_taint_of:
+                                out |= expr_deps(arg)
+                        for kw in n.keywords:
+                            if kw.arg in cs.returns_taint_of:
+                                out |= expr_deps(kw.value)
+            return out
+
+        def expr_host_local(expr: ast.AST) -> bool:
+            for n in ast.walk(expr):
+                if isinstance(n, ast.Name) and (
+                    n.id in host_names or HOST_LOCAL_NAMES.search(n.id)
+                ):
+                    return True
+                if isinstance(n, ast.Attribute) and HOST_LOCAL_NAMES.search(n.attr):
+                    return True
+                if isinstance(n, ast.Call):
+                    q = ctx.qual(n.func)
+                    if is_host_local_qual(q):
+                        return True
+                    cs = self.for_call(ctx, n, fn)
+                    if cs is not None and cs.returns_host_local:
+                        return True
+            return False
+
+        rng_consumed: set[str] = set()
+        rng_derived: set[str] = set()
+
+        # SOURCE ORDER matters: a `queue = stop_gradient(queue)`
+        # rebinding must be threaded before the einsum below it is
+        # scanned (walk_own's stack order is arbitrary); position sort
+        # approximates flow order at summary precision
+        nodes = sorted(
+            walk_own(fn),
+            key=lambda n: (getattr(n, "lineno", 0), getattr(n, "col_offset", 0)),
+        )
+        for node in nodes:
+            # -- assignments thread taint through locals ------------------
+            if isinstance(node, ast.Assign) and node.value is not None:
+                t = expr_deps(node.value)
+                hl = expr_host_local(node.value)
+                for tgt in node.targets:
+                    names = (
+                        [tgt] if isinstance(tgt, ast.Name)
+                        else [e for e in getattr(tgt, "elts", []) if isinstance(e, ast.Name)]
+                    )
+                    for nm in names:
+                        if t:
+                            deps[nm.id] = set(t)
+                        else:
+                            deps.pop(nm.id, None)
+                        if hl:
+                            host_names.add(nm.id)
+                        else:
+                            host_names.discard(nm.id)
+            # -- calls: prng use, collectives, sink hits ------------------
+            if isinstance(node, ast.Call):
+                q = ctx.qual(node.func)
+                base = basename(q)
+                # collectives issued directly
+                if base in COLLECTIVES_AXIS_ARG1:
+                    axis_expr = _axis_expr_of(ctx, node)
+                    axis_param = None
+                    tokens: frozenset[str] = frozenset()
+                    if axis_expr is not None:
+                        tokens = _axis_tokens(ctx, axis_expr)
+                        if isinstance(axis_expr, ast.Name) and axis_expr.id in params:
+                            axis_param = axis_expr.id
+                    s.collectives.append(
+                        CollectiveUse(
+                            kind=base, lineno=node.lineno,
+                            axis_tokens=tokens, axis_param=axis_param,
+                        )
+                    )
+                # transitive collectives through resolved callees
+                cs = self.for_call(ctx, node, fn)
+                if cs is not None and cs.collectives:
+                    names = self._callee_params(cs)
+                    bound: dict[str, frozenset[str]] = {}
+                    for i, arg in enumerate(node.args):
+                        if i < len(names):
+                            bound[names[i]] = _axis_tokens(ctx, arg)
+                    for kw in node.keywords:
+                        if kw.arg:
+                            bound[kw.arg] = _axis_tokens(ctx, kw.value)
+                    for use in cs.collectives:
+                        tokens = use.axis_tokens
+                        axis_param = None
+                        if use.axis_param is not None:
+                            if use.axis_param in bound:
+                                tokens = bound[use.axis_param]
+                            # the bound expr may itself be a param of OURS
+                            for i, arg in enumerate(node.args):
+                                if (
+                                    i < len(names)
+                                    and names[i] == use.axis_param
+                                    and isinstance(arg, ast.Name)
+                                    and arg.id in params
+                                ):
+                                    axis_param = arg.id
+                            for kw in node.keywords:
+                                if (
+                                    kw.arg == use.axis_param
+                                    and isinstance(kw.value, ast.Name)
+                                    and kw.value.id in params
+                                ):
+                                    axis_param = kw.value.id
+                        s.collectives.append(
+                            CollectiveUse(
+                                kind=use.kind, lineno=node.lineno,
+                                axis_tokens=tokens, axis_param=axis_param,
+                                via=cs.qualname,
+                            )
+                        )
+                # prng: is a rng param consumed here?
+                if rng_params:
+                    is_derive = q in PRNG_DERIVE
+                    callee_summary = cs
+                    for arg in [*node.args, *[kw.value for kw in node.keywords]]:
+                        if isinstance(arg, ast.Name) and arg.id in rng_params:
+                            if is_derive:
+                                rng_derived.add(arg.id)
+                            elif callee_summary is not None:
+                                # the callee's own summary decides
+                                cnames = self._callee_params(callee_summary)
+                                idx = node.args.index(arg) if arg in node.args else None
+                                pname = (
+                                    cnames[idx]
+                                    if idx is not None and idx < len(cnames)
+                                    else None
+                                )
+                                if (
+                                    pname is not None
+                                    and pname in callee_summary.derives_only_rng_params
+                                ):
+                                    rng_derived.add(arg.id)
+                                else:
+                                    rng_consumed.add(arg.id)
+                            else:
+                                rng_consumed.add(arg.id)
+                # sinks: tainted operands reaching einsum/cross_entropy
+                if base == SINK_EINSUM:
+                    for arg in node.args[1:]:
+                        for origin in expr_deps(arg):
+                            if origin != "*" and origin in params:
+                                s.param_sinks.setdefault(
+                                    origin, f"einsum at line {node.lineno}"
+                                )
+                elif base == SINK_XENT:
+                    for arg in node.args:
+                        for origin in expr_deps(arg):
+                            if origin != "*" and origin in params:
+                                s.param_sinks.setdefault(
+                                    origin, f"cross_entropy at line {node.lineno}"
+                                )
+            # NB: `@` matmuls are deliberately NOT recorded in
+            # param_sinks — `x @ params["w"]` is every forward pass, and
+            # flagging each `encode(params_k, ...)` call would bury the
+            # real violations. The intra-function matmul sink in JX005
+            # still covers direct products; interprocedurally only the
+            # loss-shaped sinks (einsum / cross_entropy) count.
+            # -- returns: what flows out ----------------------------------
+            if isinstance(node, ast.Return) and node.value is not None:
+                if self._expr_sanitized(ctx, node.value, info):
+                    s.sanitizes = True
+                else:
+                    t = expr_deps(node.value)
+                    if "*" in t:
+                        s.returns_tainted = True
+                    s.returns_taint_of |= {o for o in t if o in params}
+                    if expr_host_local(node.value):
+                        s.returns_host_local = True
+
+        s.consumes_rng_params = rng_consumed
+        s.derives_only_rng_params = rng_derived - rng_consumed
+        # dedupe collectives (recursive graphs re-surface the same use
+        # through `via` chains each fixpoint pass; cap keeps it bounded)
+        seen: set[tuple] = set()
+        unique: list[CollectiveUse] = []
+        for use in s.collectives:
+            k = (use.kind, use.lineno, use.axis_tokens, use.axis_param, use.via)
+            if k not in seen:
+                seen.add(k)
+                unique.append(use)
+        s.collectives = unique[:64]
+        return s
+
+    # -- helpers ----------------------------------------------------------
+
+    @staticmethod
+    def _callee_params(summary: Summary) -> list[str]:
+        # stored on the summary's function info via the program
+        return summary._param_names  # type: ignore[attr-defined]
+
+    def _expr_sanitized(
+        self, ctx: ModuleContext, expr: ast.AST, info: FunctionInfo
+    ) -> bool:
+        """Does the expression route through stop_gradient, a known
+        sanitizing helper, or a resolved callee whose summary sanitizes?"""
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Call):
+                q = ctx.qual(n.func)
+                if is_sanitizer_qual(q):
+                    return True
+                cs = self.for_call(ctx, n, info.node)
+                if cs is not None and cs.sanitizes:
+                    return True
+        return False
+
+
+def build_summaries(program: Program) -> SummaryTable:
+    """SummaryTable for a program, cached on it (`program.summaries`)."""
+    cached = getattr(program, "summaries", None)
+    if cached is None:
+        cached = SummaryTable(program)
+        program.summaries = cached  # type: ignore[attr-defined]
+    return cached

@@ -10,4 +10,8 @@ from moco_tpu.analysis.rules import (  # noqa: F401
     jx005_stop_gradient,
     jx006_donation,
     jx007_axis_names,
+    jx008_spmd_divergence,
+    jx009_mixed_precision,
+    jx010_sharding_consistency,
+    jx011_thread_hygiene,
 )

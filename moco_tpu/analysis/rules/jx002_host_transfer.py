@@ -10,6 +10,12 @@ does on log steps only).
 
 Shape-derived casts (`int(x.shape[0])`) and literal casts are static and
 exempt.
+
+Interprocedural since mocolint v2: jitted scope closes over RESOLVED
+call edges program-wide (`callgraph.Program.jitted`), so a helper in
+another module called from the compiled step is in scope — the
+`float(loss)` two files away from the `@jax.jit` is exactly the one
+review misses.
 """
 
 from __future__ import annotations
@@ -18,6 +24,20 @@ import ast
 
 from moco_tpu.analysis.astutils import ModuleContext, walk_own
 from moco_tpu.analysis.engine import rule
+
+
+def jitted_functions(ctx: ModuleContext) -> list[ast.FunctionDef]:
+    """This module's functions in jitted scope: the module-local closure
+    plus, when a whole-program call graph is attached, any function
+    reached from a jitted root in ANOTHER module."""
+    prog = getattr(ctx, "program", None)
+    if prog is None:
+        return sorted(ctx.jitted, key=lambda f: f.lineno)
+    out = set(ctx.jitted)
+    for fn in ctx.functions:
+        if prog.in_jitted_scope(fn):
+            out.add(fn)
+    return sorted(out, key=lambda f: f.lineno)
 
 _CAST_BUILTINS = {"float", "int", "bool"}
 _NUMPY_SINKS = {
@@ -45,7 +65,7 @@ def _is_static_cast(arg: ast.AST) -> bool:
 
 @rule("JX002", "implicit host transfer (float()/int()/bool()/np.asarray/.item()) in jitted scope")
 def check(ctx: ModuleContext):
-    for fn in ctx.jitted:
+    for fn in jitted_functions(ctx):
         for node in walk_own(fn):
             if not isinstance(node, ast.Call):
                 continue

@@ -18,6 +18,12 @@ What counts:
 The analysis is branch-aware (exclusive `if`/`else` arms don't sum) and
 runs loop bodies twice, so a key consumed once per iteration without
 re-derivation is caught.
+
+Interprocedural since mocolint v2: a call to a RESOLVED function whose
+dataflow summary proves it only DERIVES from its key parameter (a pure
+`fold_in` wrapper) no longer counts as consumption — and a helper that
+truly samples with the key still does. Unresolved calls keep the
+conservative behavior (consume).
 """
 
 from __future__ import annotations
@@ -73,6 +79,17 @@ class _KeyFlow(FlowVisitor):
                 )
         state[name] = (count + 1, node.lineno)
 
+    def _derive_only_params(self, node: ast.Call) -> set[str]:
+        """Callee params the summary proves are derive-only (fold_in
+        wrappers); empty when the call does not resolve."""
+        prog = getattr(self.ctx, "program", None)
+        if prog is None:
+            return set()
+        from moco_tpu.analysis.dataflow import build_summaries
+
+        summary = build_summaries(prog).for_call(self.ctx, node, None)
+        return set() if summary is None else summary.derives_only_rng_params
+
     def _scan_expr(self, expr: ast.AST, state) -> None:
         for node in ast.walk(expr):
             if not isinstance(node, ast.Call):
@@ -82,8 +99,22 @@ class _KeyFlow(FlowVisitor):
                 continue
             if q in _PRODUCERS:
                 continue
-            for arg in [*node.args, *[kw.value for kw in node.keywords]]:
+            derive_only = self._derive_only_params(node)
+            callee_params: list[str] = []
+            if derive_only:
+                prog = self.ctx.program
+                info = prog.resolve_call(self.ctx, node, None)
+                callee_params = info.param_names() if info is not None else []
+            for i, arg in enumerate(node.args):
                 if isinstance(arg, ast.Name) and arg.id in state:
+                    if i < len(callee_params) and callee_params[i] in derive_only:
+                        continue  # proven pure derivation, not a use
+                    self._consume(arg.id, node, state)
+            for kw in node.keywords:
+                arg = kw.value
+                if isinstance(arg, ast.Name) and arg.id in state:
+                    if kw.arg in derive_only:
+                        continue
                     self._consume(arg.id, node, state)
 
     def visit_stmt(self, stmt: ast.stmt, state) -> None:

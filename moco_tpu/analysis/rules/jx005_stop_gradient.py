@@ -20,11 +20,25 @@ named `queue`. Sanitization: passing through `stop_gradient` (a
 rebinding like ``k = lax.stop_gradient(k)`` cleans the name).
 Sinks: `@` matmuls, `einsum` calls, and `cross_entropy` calls whose
 operand is tainted-and-unsanitized.
+
+Interprocedural since mocolint v2 (the MoCo chain flows ACROSS
+`core/moco.py` → `ops/losses.py` → `core/queue.py`):
+
+- a call to a resolved helper whose dataflow summary says its return
+  carries its argument's taint (``k = encode(params_k, x)``) taints the
+  result even though the helper lives in another module;
+- a helper whose summary proves it sanitizes (routes its return through
+  `stop_gradient`) cleans, without being on the hard-coded list;
+- passing a tainted value to a helper parameter that the summary shows
+  reaching a matmul/einsum/cross_entropy inside the callee fires AT THE
+  CALL SITE — the cross-function violation the per-function pass was
+  blind to.
 """
 
 from __future__ import annotations
 
 import ast
+from typing import Optional
 
 from moco_tpu.analysis.astutils import FlowVisitor, ModuleContext, stmt_exprs
 from moco_tpu.analysis.engine import rule
@@ -38,6 +52,18 @@ _TAINT_PARAMS = {"params_k", "batch_stats_k", "queue"}
 # helpers that stop-gradient their key/queue inputs internally — the
 # known-good patterns; values built through them are clean
 _SANITIZERS = ("stop_gradient", "infonce_logits", "enqueue", "fused_infonce_loss")
+
+
+def _bind_args(call: ast.Call, param_names: list[str]) -> list[tuple[str, ast.AST]]:
+    """(callee param name, argument expr) pairs for a resolved call."""
+    out: list[tuple[str, ast.AST]] = []
+    for i, arg in enumerate(call.args):
+        if i < len(param_names):
+            out.append((param_names[i], arg))
+    for kw in call.keywords:
+        if kw.arg:
+            out.append((kw.arg, kw.value))
+    return out
 
 
 def _terminal_name(node: ast.AST) -> str | None:
@@ -66,6 +92,21 @@ class _TaintFlow(FlowVisitor):
         self.ctx = ctx
         self.findings: list[tuple[ast.AST, str]] = []
         self._seen: set[int] = set()
+        self._summaries = None
+        prog = getattr(ctx, "program", None)
+        if prog is not None:
+            from moco_tpu.analysis.dataflow import build_summaries
+
+            self._summaries = build_summaries(prog)
+
+    def _callee(self, call: ast.Call):
+        """(summary, param_names) for a resolved call, else (None, [])."""
+        if self._summaries is None:
+            return None, []
+        info = self.ctx.program.resolve_call(self.ctx, call, None)
+        if info is None:
+            return None, []
+        return self._summaries.get(info.qualname), info.param_names()
 
     def enter_function(self, fn: ast.FunctionDef, state) -> None:
         args = fn.args
@@ -79,16 +120,57 @@ class _TaintFlow(FlowVisitor):
     def merge(self, a, b):
         return {**b, **a}
 
-    def _tainted_in(self, expr: ast.AST, state) -> str | None:
-        """First tainted name occurring in `expr`, unless the expression
-        routes through stop_gradient / a sanitizing helper."""
-        if _sanitized(self.ctx, expr):
+    def _summary_sanitized(self, expr: ast.AST) -> bool:
+        """A resolved callee in the expression whose summary proves it
+        stop-gradients its return (beyond the hard-coded helper list)."""
+        if self._summaries is None:
+            return False
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Call):
+                summary, _ = self._callee(n)
+                if summary is not None and summary.sanitizes:
+                    return True
+        return False
+
+    def _call_returns_taint(self, call: ast.Call, state) -> Optional[str]:
+        """Tainted name flowing OUT of a resolved call per its summary."""
+        summary, names = self._callee(call)
+        if summary is None:
             return None
+        if summary.sanitizes:
+            return None
+        if summary.returns_tainted:
+            return f"{call.func.attr if isinstance(call.func, ast.Attribute) else getattr(call.func, 'id', '?')}()"
+        bound = _bind_args(call, names)
+        for pname, arg in bound:
+            if pname in summary.returns_taint_of:
+                name = self._tainted_in(arg, state)
+                if name:
+                    return name
+        return None
+
+    def _direct_taint(self, expr: ast.AST, state) -> str | None:
         for n in ast.walk(expr):
             if isinstance(n, ast.Name) and n.id in state:
                 return n.id
             if isinstance(n, ast.Attribute) and n.attr in _TAINT_ATTRS:
                 return n.attr
+        return None
+
+    def _tainted_in(self, expr: ast.AST, state) -> str | None:
+        """First tainted name occurring in `expr`, unless the expression
+        routes through stop_gradient / a sanitizing helper (hard-coded
+        or summary-proven)."""
+        if _sanitized(self.ctx, expr) or self._summary_sanitized(expr):
+            return None
+        name = self._direct_taint(expr, state)
+        if name:
+            return name
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Call):
+                name = self._call_returns_taint(n, state)
+                if name:
+                    return name
         return None
 
     def _source_taints(self, expr: ast.AST, state) -> bool:
@@ -113,7 +195,24 @@ class _TaintFlow(FlowVisitor):
 
     def _scan_sinks(self, expr: ast.AST, state) -> bool:
         fired = False
+        # nodes under a sanitizing call are clean territory: the whole
+        # `stop_gradient(helper(params_k, ...))` expression is the fix,
+        # not a finding
+        shielded: set[int] = set()
         for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                q = self.ctx.qual(node.func)
+                summary, _ = self._callee(node)
+                if (
+                    (q and (q in _SANITIZERS or q.endswith(tuple("." + s for s in _SANITIZERS))))
+                    or (summary is not None and summary.sanitizes)
+                ):
+                    for sub in ast.walk(node):
+                        if sub is not node:
+                            shielded.add(id(sub))
+        for node in ast.walk(expr):
+            if id(node) in shielded:
+                continue
             if isinstance(node, ast.BinOp) and isinstance(node.op, ast.MatMult):
                 for side in (node.left, node.right):
                     name = self._tainted_in(side, state)
@@ -134,6 +233,27 @@ class _TaintFlow(FlowVisitor):
                         if name:
                             self._flag(node, name, "cross_entropy")
                             fired = True
+                elif not (
+                    q in _SANITIZERS or q.endswith(tuple("." + s for s in _SANITIZERS))
+                ):
+                    # interprocedural sink: a tainted value handed to a
+                    # helper parameter that reaches a loss sink INSIDE
+                    # the callee (summary-proven) fires at the call site.
+                    # The hard-coded sanitizers take key/queue tensors
+                    # raw BY CONTRACT (they stop-gradient internally).
+                    summary, names = self._callee(node)
+                    if summary is not None and not summary.sanitizes and summary.param_sinks:
+                        for pname, arg in _bind_args(node, names):
+                            if pname not in summary.param_sinks:
+                                continue
+                            name = self._tainted_in(arg, state)
+                            if name:
+                                self._flag(
+                                    node, name,
+                                    f"{summary.qualname}() which feeds it to "
+                                    f"a loss sink ({summary.param_sinks[pname]})",
+                                )
+                                fired = True
         return fired
 
     def visit_stmt(self, stmt: ast.stmt, state) -> None:

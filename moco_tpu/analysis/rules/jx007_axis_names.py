@@ -43,8 +43,11 @@ def _is_pspec(qual: Optional[str]) -> bool:
 
 def _tokens_of(ctx: ModuleContext, expr: ast.AST) -> set[str]:
     """Axis tokens in a spec/axis expression: string values plus symbol
-    names (symbols also resolve through module string constants)."""
+    names (symbols also resolve through module string constants, and —
+    when a whole-program call graph is attached — through constants
+    imported from another analyzed module)."""
     tokens: set[str] = set()
+    prog = getattr(ctx, "program", None)
     for n in ast.walk(expr):
         if isinstance(n, ast.Constant) and isinstance(n.value, str):
             tokens.add(n.value)
@@ -52,6 +55,12 @@ def _tokens_of(ctx: ModuleContext, expr: ast.AST) -> set[str]:
             tokens.add(n.id)
             if n.id in ctx.constants:
                 tokens.add(ctx.constants[n.id])
+            elif prog is not None and n.id in ctx.imports:
+                origin = ctx.imports[n.id]
+                mod, _, const = origin.rpartition(".")
+                other = prog.by_module.get(mod)
+                if other is not None and const in other.constants:
+                    tokens.add(other.constants[const])
     return tokens
 
 

@@ -1,0 +1,199 @@
+"""JX008 — a collective issued under host-local control flow.
+
+THE pod-deadlock bug class. SPMD correctness requires every process to
+issue the same collectives in the same order: a collective reached
+under a condition only SOME hosts satisfy leaves the others blocked in
+the matching collective forever — no error, no timeout, just a hung pod
+burning its reservation. PR 4 hit a live instance (the fleet stats
+all_gather had to be re-keyed from per-host wall-clock state onto the
+replicated log schedule so every host agrees on the gather steps).
+
+Host-local sources (each process sees its own value):
+
+- process identity: `jax.process_index()`, `os.getpid()`,
+  `socket.gethostname()`;
+- wall clock: `time.time()/perf_counter()/monotonic()`;
+- environment reads, stdlib `random`;
+- per-host counters: names matching io_retries / decode_failures /
+  heartbeat / retries (the retry layer's and input wire's per-host
+  state);
+- exception handlers: an `except:` body runs only on the host where the
+  exception fired — a collective inside one is divergent by
+  construction.
+
+The check is flow-aware (a name assigned from `jax.process_index()`
+carries the taint into a later `if`) and interprocedural both ways: a
+HELPER that returns a host-local value taints the caller's condition,
+and a helper that ISSUES a collective (transitively, per the dataflow
+summaries) counts as a collective at its call site.
+
+Deterministic per-host branching with NO collective inside — `if
+process_index == 0: log(...)` — is the correct idiom and stays silent.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from moco_tpu.analysis.astutils import ModuleContext
+from moco_tpu.analysis.engine import rule
+from moco_tpu.analysis.dataflow import (
+    COLLECTIVES_AXIS_ARG1,
+    HOST_LOCAL_NAMES,
+    basename,
+    build_summaries,
+    is_host_local_qual,
+)
+
+
+class _Walker:
+    """Per-function walk threading host-taint through assignments and a
+    stack of host-local conditions lexically in scope."""
+
+    def __init__(self, ctx: ModuleContext, summaries):
+        self.ctx = ctx
+        self.summaries = summaries
+        self.findings: list[tuple[ast.AST, str]] = []
+        self._seen: set[int] = set()
+        self.tainted: set[str] = set()
+
+    # -- host-local taint of an expression -------------------------------
+
+    def _expr_host_local(self, expr: ast.AST) -> Optional[str]:
+        """A short description of the host-local source in `expr`, or
+        None when the expression is replicated-safe."""
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Name):
+                if n.id in self.tainted:
+                    return f"'{n.id}' (host-local, assigned above)"
+                if HOST_LOCAL_NAMES.search(n.id):
+                    return f"'{n.id}' (per-host counter)"
+            elif isinstance(n, ast.Attribute) and HOST_LOCAL_NAMES.search(n.attr):
+                return f".{n.attr} (per-host counter)"
+            elif isinstance(n, ast.Call):
+                q = self.ctx.qual(n.func)
+                if is_host_local_qual(q):
+                    return f"{q}()"
+                if self.summaries is not None:
+                    s = self.summaries.for_call(self.ctx, n, None)
+                    if s is not None and s.returns_host_local:
+                        return f"{s.qualname}() (returns a host-local value)"
+        return None
+
+    # -- collectives in an expression ------------------------------------
+
+    def _collectives_in(self, expr: ast.AST):
+        for n in ast.walk(expr):
+            if not isinstance(n, ast.Call):
+                continue
+            base = basename(self.ctx.qual(n.func))
+            if base in COLLECTIVES_AXIS_ARG1:
+                yield n, base
+            elif self.summaries is not None:
+                s = self.summaries.for_call(self.ctx, n, None)
+                if s is not None and s.collectives:
+                    kinds = sorted({u.kind for u in s.collectives})
+                    yield n, f"{'/'.join(kinds)} via {s.qualname}()"
+
+    def _flag(self, node: ast.AST, what: str, cond: str) -> None:
+        if node.lineno in self._seen:
+            return
+        self._seen.add(node.lineno)
+        self.findings.append(
+            (
+                node,
+                f"collective {what} issued under host-local control flow "
+                f"[{cond}] — hosts that take the other branch never enter "
+                "the collective and the pod deadlocks silently; key the "
+                "schedule on replicated state (see obs/fleet.py's "
+                "log-schedule keying)",
+            )
+        )
+
+    # -- statement walk ---------------------------------------------------
+
+    def run(self, fn: ast.FunctionDef) -> None:
+        self._block(fn.body, conds=[])
+
+    def _scan(self, expr: ast.AST, conds: list[str]) -> None:
+        if not conds:
+            return
+        for node, what in self._collectives_in(expr):
+            self._flag(node, what, conds[-1])
+
+    def _block(self, stmts: list[ast.stmt], conds: list[str]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.If, ast.While)):
+                reason = self._expr_host_local(stmt.test)
+                inner = conds + [f"condition depends on {reason}"] if reason else conds
+                self._scan(stmt.test, conds)
+                self._block(stmt.body, inner)
+                self._block(stmt.orelse, inner)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                reason = self._expr_host_local(stmt.iter)
+                inner = conds + [f"loop iterates over {reason}"] if reason else conds
+                self._scan(stmt.iter, conds)
+                self._block(stmt.body, inner)
+                self._block(stmt.orelse, inner)
+            elif isinstance(stmt, ast.Try):
+                self._block(stmt.body, conds)
+                for handler in stmt.handlers:
+                    self._block(
+                        handler.body,
+                        conds + ["inside an exception handler (exceptions fire per host)"],
+                    )
+                self._block(stmt.orelse, conds)
+                self._block(stmt.finalbody, conds)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self._scan(item.context_expr, conds)
+                self._block(stmt.body, conds)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # nested defs inherit the enclosing conditions: a closure
+                # defined under a host-local branch still diverges when
+                # called from there
+                self._block(stmt.body, conds)
+            elif isinstance(stmt, ast.ClassDef):
+                continue
+            else:
+                # taint threading, then sinks
+                if isinstance(stmt, ast.Assign) and stmt.value is not None:
+                    reason = self._expr_host_local(stmt.value)
+                    for tgt in stmt.targets:
+                        names = (
+                            [tgt] if isinstance(tgt, ast.Name)
+                            else [e for e in getattr(tgt, "elts", []) if isinstance(e, ast.Name)]
+                        )
+                        for nm in names:
+                            if reason:
+                                self.tainted.add(nm.id)
+                            else:
+                                self.tainted.discard(nm.id)
+                # ternaries count as conditions too
+                for n in ast.walk(stmt):
+                    if isinstance(n, ast.IfExp):
+                        reason = self._expr_host_local(n.test)
+                        if reason:
+                            for cnode, what in self._collectives_in(n.body):
+                                self._flag(cnode, what, f"condition depends on {reason}")
+                            for cnode, what in self._collectives_in(n.orelse):
+                                self._flag(cnode, what, f"condition depends on {reason}")
+                self._scan(stmt, conds)
+
+
+@rule("JX008", "collective issued under host-local control flow (SPMD divergence/deadlock)")
+def check(ctx: ModuleContext):
+    prog = getattr(ctx, "program", None)
+    summaries = build_summaries(prog) if prog is not None else None
+    nested: set[ast.AST] = set()
+    for g in ctx.functions:
+        for n in ast.walk(g):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) and n is not g:
+                nested.add(n)
+    for fn in ctx.functions:
+        if fn in nested:
+            continue
+        w = _Walker(ctx, summaries)
+        w.run(fn)
+        yield from w.findings

@@ -1,0 +1,159 @@
+"""JX009 — bf16/f16 operands reaching a reduction without f32 accumulation.
+
+On TPU the MXU natively accumulates bf16 matmuls in f32 — but ONLY when
+asked: `preferred_element_type=jnp.float32`. Without it, XLA is free to
+accumulate a `bf16 @ bf16` product in bf16, and at MoCo scale the
+damage is quantified: a 65536-key InfoNCE logit row sums 128-dim
+products whose bf16 accumulation drifts ~1e-2 — enough to reorder
+logits near the temperature scale. Same story for cross-replica `psum`
+of bf16 gradients: each hop rounds to bf16, and an 8-host ring loses
+~3 bits of mantissa on the way around. The repo's own kernels
+(`ops/fused_infonce.py`, `ops/flash_attention.py`) all pass
+`preferred_element_type=jnp.float32`; this rule keeps every new
+matmul/einsum/psum site honest.
+
+What counts as a low-precision value: anything routed through a
+`bfloat16`/`float16` cast or dtype argument (`x.astype(jnp.bfloat16)`,
+`jnp.asarray(x, "bfloat16")`, `dtype=compute_dtype` where the local
+binding mentions bf16). An `.astype(jnp.float32)` rebinding cleans.
+
+Sinks:
+- `jnp.matmul`/`jnp.dot`/`jnp.einsum`/`lax.dot_general`/`@` with a
+  low-precision operand and no `preferred_element_type` kwarg;
+- `lax.psum`/`pmean`/`psum_scatter` on a low-precision operand (cast up
+  before the reduction, down after — the wire cost is the point of
+  bf16; the ACCUMULATION is not where to save).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from moco_tpu.analysis.astutils import ModuleContext, walk_own
+from moco_tpu.analysis.engine import rule
+from moco_tpu.analysis.dataflow import basename
+
+_LOW_TOKENS = ("bfloat16", "float16", "bf16", "fp16", "half")
+_HIGH_TOKENS = ("float32", "f32", "float64")
+_MATMUL_SINKS = {"matmul", "dot", "einsum", "dot_general", "tensordot"}
+_REDUCE_SINKS = {"psum", "pmean", "psum_scatter"}
+
+
+def _mentions(expr: ast.AST, tokens: tuple[str, ...]) -> bool:
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Attribute) and n.attr in tokens:
+            return True
+        if isinstance(n, ast.Constant) and isinstance(n.value, str) and n.value in tokens:
+            return True
+        if isinstance(n, ast.Name) and n.id in tokens:
+            return True
+    return False
+
+
+def _has_preferred(call: ast.Call) -> bool:
+    return any(kw.arg == "preferred_element_type" for kw in call.keywords)
+
+
+class _PrecisionFlow:
+    """Ordered walk of one function: names bound to low-precision values
+    flow into sinks; `.astype(float32)` rebindings clean."""
+
+    def __init__(self, ctx: ModuleContext):
+        self.ctx = ctx
+        self.findings: list[tuple[ast.AST, str]] = []
+        self._seen: set[int] = set()
+        self.low: set[str] = set()
+
+    def _expr_low(self, expr: ast.AST) -> Optional[str]:
+        """Name/description of a low-precision source in `expr`."""
+        if _mentions(expr, _HIGH_TOKENS) and not _mentions(expr, _LOW_TOKENS):
+            return None  # explicit f32 routing wins
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Name) and n.id in self.low:
+                return n.id
+            if isinstance(n, ast.Call):
+                # x.astype(jnp.bfloat16) / jnp.asarray(x, "bfloat16") /
+                # cast-through-a-low-binding (dtype=compute_dtype)
+                for arg in [*n.args, *[kw.value for kw in n.keywords]]:
+                    if _mentions(arg, _LOW_TOKENS) or (
+                        isinstance(arg, ast.Name) and arg.id in self.low
+                    ):
+                        return "a bf16/f16 cast"
+        return None
+
+    def _flag(self, node: ast.AST, sink: str, source: str, advice: str) -> None:
+        if node.lineno in self._seen:
+            return
+        self._seen.add(node.lineno)
+        self.findings.append(
+            (
+                node,
+                f"low-precision operand ({source}) reaches {sink} without "
+                f"f32 accumulation — {advice}",
+            )
+        )
+
+    def run(self, fn: ast.FunctionDef) -> None:
+        args = fn.args
+        for a in [*args.posonlyargs, *args.args, *args.kwonlyargs]:
+            if any(t in a.arg for t in ("bf16", "fp16", "half")):
+                self.low.add(a.arg)
+        nodes = sorted(
+            walk_own(fn),
+            key=lambda n: (getattr(n, "lineno", 0), getattr(n, "col_offset", 0)),
+        )
+        for node in nodes:
+            if isinstance(node, ast.Assign) and node.value is not None:
+                src = self._expr_low(node.value)
+                for tgt in node.targets:
+                    names = (
+                        [tgt] if isinstance(tgt, ast.Name)
+                        else [e for e in getattr(tgt, "elts", []) if isinstance(e, ast.Name)]
+                    )
+                    for nm in names:
+                        if src:
+                            self.low.add(nm.id)
+                        else:
+                            self.low.discard(nm.id)
+            if isinstance(node, ast.BinOp) and isinstance(node.op, ast.MatMult):
+                for side in (node.left, node.right):
+                    src = self._expr_low(side)
+                    if src:
+                        self._flag(
+                            node, "an `@` matmul", src,
+                            "use jnp.matmul(..., preferred_element_type=jnp.float32) "
+                            "or cast the operands up",
+                        )
+            elif isinstance(node, ast.Call):
+                base = basename(self.ctx.qual(node.func))
+                if base in _MATMUL_SINKS and not _has_preferred(node):
+                    for arg in node.args:
+                        src = self._expr_low(arg)
+                        if src:
+                            self._flag(
+                                node, f"{base}()", src,
+                                "pass preferred_element_type=jnp.float32 (MXU "
+                                "accumulates bf16 in f32 only when asked; see "
+                                "ops/fused_infonce.py)",
+                            )
+                            break
+                elif base in _REDUCE_SINKS:
+                    for arg in node.args[:1]:
+                        src = self._expr_low(arg)
+                        if src:
+                            self._flag(
+                                node, f"lax.{base}()", src,
+                                "cast up before the cross-replica reduction "
+                                "(each ring hop rounds to bf16) and down after",
+                            )
+
+
+@rule("JX009", "bf16/f16 operand reaches matmul/einsum/psum without f32 accumulation")
+def check(ctx: ModuleContext):
+    # every function analyzed as its own scope (walk_own stops at nested
+    # defs, so inner step functions get their own fresh flow)
+    for fn in ctx.functions:
+        flow = _PrecisionFlow(ctx)
+        flow.run(fn)
+        yield from flow.findings

@@ -1,0 +1,144 @@
+"""JX010 — interprocedural sharding/axis-name consistency.
+
+JX007 checks collectives LEXICALLY inside the wrapped function against
+the enclosing `shard_map`/`pmap` declaration. But this repo's
+collectives live in helpers: the shard_map'd step in `core/moco.py`
+calls `parallel/shuffle.py`'s `balanced_shuffle(rng, x, axis_name)`,
+which issues the `all_to_all` — two modules away from the declaration
+it must agree with. After a mesh-axis rename, the helper's collective
+silently binds the WRONG axis of the same mesh and the reduction runs
+over the wrong replica group ("trains", learns garbage). This is also
+the precondition for the ZeRO-3 work: persistently sharded optimizer
+state threads PartitionSpecs through several helper layers.
+
+This rule closes the gap with the dataflow summaries:
+
+- every function's summary carries its collectives, transitively, with
+  axis expressions resolved through call-site bindings (a helper whose
+  collective names its OWN `axis_name` parameter is resolved by the
+  caller's argument — including constants imported from another
+  module, e.g. `DATA_AXIS` from `parallel/mesh.py`);
+- for each `shard_map(f, ...)`/`pmap(f, axis_name=...)` wrap with a
+  resolvable declaration, the TRANSITIVE collectives of `f` are checked
+  against the declared axes; lexically-direct collectives are left to
+  JX007 (no double findings) — this rule fires on the ones reached
+  `via` a helper, anchored at the helper call's line in the wrapped
+  function.
+
+Unresolvable specs or axis expressions leave the wrap unchecked — same
+no-guessing contract as JX007.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from moco_tpu.analysis.astutils import ModuleContext, jit_kind
+from moco_tpu.analysis.engine import rule
+from moco_tpu.analysis.dataflow import build_summaries
+from moco_tpu.analysis.rules.jx007_axis_names import (
+    _spec_tokens,
+    _tokens_of,
+)
+
+
+def _declared_axes(
+    ctx: ModuleContext, node: ast.Call, kind: str, env: dict[str, ast.AST]
+) -> Optional[set[str]]:
+    """Axis tokens a shard_map/pmap wrap declares, or None when the
+    declaration cannot be resolved (leave unchecked)."""
+    declared: set[str] = set()
+    closed = True
+    if kind == "pmap":
+        axis_kw = next((kw.value for kw in node.keywords if kw.arg == "axis_name"), None)
+        if axis_kw is not None:
+            declared = _tokens_of(ctx, axis_kw)
+    else:
+        spec_exprs = [
+            kw.value for kw in node.keywords if kw.arg in ("in_specs", "out_specs")
+        ]
+        spec_exprs += node.args[2:4]
+        if not spec_exprs:
+            closed = False
+        for expr in spec_exprs:
+            t, c = _spec_tokens(ctx, expr, env)
+            declared |= t
+            closed &= c
+    return declared if closed else None
+
+
+@rule("JX010", "helper-issued collective's axis disagrees with the shard_map declaration")
+def check(ctx: ModuleContext):
+    prog = getattr(ctx, "program", None)
+    if prog is None:
+        return
+    summaries = build_summaries(prog)
+
+    module_assigns: dict[str, ast.AST] = {}
+    for node in ctx.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and isinstance(
+            node.targets[0], ast.Name
+        ):
+            module_assigns[node.targets[0].id] = node.value
+
+    enclosing: dict[int, ast.FunctionDef] = {}
+    for f in ctx.functions:
+        for n in ast.walk(f):
+            enclosing[id(n)] = f
+
+    def local_env(fn: Optional[ast.FunctionDef]) -> dict[str, ast.AST]:
+        env = dict(module_assigns)
+        if fn is not None:
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 and isinstance(
+                    node.targets[0], ast.Name
+                ):
+                    env[node.targets[0].id] = node.value
+        return env
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        kind = jit_kind(ctx.qual(node.func))
+        if kind not in ("shard_map", "pmap"):
+            continue
+        if not (node.args and isinstance(node.args[0], ast.Name)):
+            continue
+        wrapped_info = prog.resolve_call(
+            ctx, ast.Call(func=node.args[0], args=[], keywords=[]), None
+        )
+        if wrapped_info is None:
+            defs = ctx.defs_by_name.get(node.args[0].id, [])
+            wrapped_info = prog.info_for_node(defs[-1]) if defs else None
+        if wrapped_info is None:
+            continue
+        declared = _declared_axes(
+            ctx, node, kind, local_env(enclosing.get(id(node)))
+        )
+        if declared is None:
+            continue
+        if wrapped_info.ctx is not ctx:
+            continue  # findings must anchor to lines of THIS file
+        summary = summaries.get(wrapped_info.qualname)
+        if summary is None:
+            continue
+        for use in summary.collectives:
+            if use.via is None:
+                continue  # lexically direct: JX007's jurisdiction
+            if use.axis_param is not None:
+                continue  # still bound to the wrapped fn's own param: the
+                # axis comes in as data, unresolvable here
+            if not use.axis_tokens:
+                continue  # no-guessing
+            if use.axis_tokens & declared:
+                continue
+            pretty = sorted(use.axis_tokens)[0]
+            yield use.lineno, (
+                f"collective {use.kind}(axis={pretty!r}) reached via "
+                f"{use.via}() from '{wrapped_info.name}' names an axis the "
+                f"enclosing {kind} does not declare "
+                f"(declared: {', '.join(sorted(declared)) or 'none'}) — "
+                "after a mesh-axis rename this binds the WRONG axis and "
+                "reduces over the wrong replica group, or deadlocks the pod"
+            )

@@ -65,6 +65,8 @@ import threading
 
 import jax
 
+from moco_tpu.analysis import sanitizer as _schedule
+
 COLLECTIVES = (
     "all_gather",
     "all_to_all",
@@ -85,15 +87,28 @@ def tree_bytes(tree) -> int:
         dtype = getattr(leaf, "dtype", None)
         if size is None or dtype is None:
             continue
-        total += int(size) * jax.numpy.dtype(dtype).itemsize
+        total += int(size) * jax.numpy.dtype(dtype).itemsize  # mocolint: disable=JX002  (.size/.dtype are trace-STATIC metadata, exact and free during tracing)
     return total
+
+
+def _shape_signature(tree) -> str:
+    """Stable (shape, dtype) signature of a pytree's leaves, for the
+    schedule sanitizer. Like `tree_bytes`, works on tracers."""
+    parts = []
+    for leaf in jax.tree.leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        parts.append(f"{tuple(shape)}:{dtype}")
+    return ",".join(parts)
 
 
 def collective_bytes(collective: str, nbytes: int, axis_size: int) -> int:
     """Per-device wire bytes for ONE call of `collective` on a local
     operand of `nbytes` over an axis of `axis_size` (see the module
     docstring's cost model)."""
-    n = int(axis_size)
+    n = int(axis_size)  # mocolint: disable=JX002  (mesh axis size is a static Python int during tracing)
     if collective not in COLLECTIVES:
         raise ValueError(f"unknown collective {collective!r} (known: {COLLECTIVES})")
     if collective == "device_put":
@@ -149,13 +164,19 @@ def tag(
     idempotent across retraces.
     """
     nbytes = tree_bytes(operand)
+    if _schedule.enabled():
+        # runtime collective-schedule sanitizer (analysis/sanitizer.py):
+        # the site tag doubles as the schedule recorder's event — shapes
+        # and dtypes are static during tracing, so this signature is the
+        # cross-host agreement contract. Zero-cost when not installed.
+        _schedule.on_tag(site, collective, _shape_signature(operand))
     rec = CommSite(
         site=site,
         collective=collective,
         operand_bytes=nbytes,
         bytes_per_call=collective_bytes(collective, nbytes, axis_size),
-        calls_per_step=int(calls_per_step),
-        axis_size=int(axis_size),
+        calls_per_step=int(calls_per_step),  # mocolint: disable=JX002  (static site metadata, recorded once per trace)
+        axis_size=int(axis_size),  # mocolint: disable=JX002  (static site metadata, recorded once per trace)
     )
     with _LOCK:
         _LEDGER[site] = rec

@@ -129,7 +129,7 @@ def queue_age(
     fixed `num_buckets` length so the JSONL schema is stable)."""
     depth = max(num_negatives // max(global_batch, 1), 1)  # batches held
     ages = jnp.minimum(jnp.arange(1, depth + 1, dtype=jnp.float32), step.astype(jnp.float32))
-    edges = jnp.linspace(0.0, float(depth), num_buckets + 1)
+    edges = jnp.linspace(0.0, float(depth), num_buckets + 1)  # mocolint: disable=JX002  (depth is a static Python int from config, not a traced value)
     # bucket membership via searchsorted (jnp.histogram is fine too, but
     # this keeps the bucket count static and the dtype explicit)
     bucket = jnp.clip(jnp.searchsorted(edges, ages, side="right") - 1, 0, num_buckets - 1)

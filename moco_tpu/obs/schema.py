@@ -111,6 +111,10 @@ FIELD_VALIDATORS = {
     "io_retries": _counter_map,
     # mocolint runtime arm (present on every line under --strict-tracing)
     "compile_cache_misses": _int_like,
+    # collective-schedule sanitizer (--sanitize-collectives): short hash
+    # of this process's traced (site, kind, shape) collective schedule —
+    # flat on a healthy run, and every process's must agree
+    "collective_schedule_hash": lambda v: isinstance(v, str),
     "watchdog_timeout": _num,
     # fleet observability (obs/fleet.py; process-0 lines only)
     "fleet_hosts": _int_like,

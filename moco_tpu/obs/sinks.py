@@ -285,6 +285,11 @@ class PrometheusSink(Sink):
     def close(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        # join-on-close (mocolint JX011): shutdown() unblocks
+        # serve_forever, but until the thread actually exits it pins the
+        # bound port and the handler's references — a restart-in-process
+        # (tests, chained bench legs) would hit EADDRINUSE
+        self._thread.join(timeout=5.0)
 
 
 class MultiSink(Sink):

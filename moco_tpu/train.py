@@ -211,6 +211,23 @@ def _train_impl(
         compile_monitor = CompileMonitor(step_fn)
         recompile_guard = RecompileGuard(config.recompile_warmup_steps)
 
+    # Collective-schedule sanitizer (mocolint runtime arm, analysis/
+    # sanitizer.py): installed BEFORE the first step traces so every
+    # comms.tag site lands in the recorder; the cross-process check
+    # piggybacks on log steps and aborts with a per-site diff before a
+    # schedule mismatch can deadlock the pod.
+    schedule_sanitizer = None
+    _prev_recorder = None
+    if config.sanitize_collectives:
+        from moco_tpu.analysis.sanitizer import ScheduleSanitizer, install_recorder
+
+        schedule_sanitizer = ScheduleSanitizer(
+            config.workdir,
+            process_index=jax.process_index(),
+            num_processes=jax.process_count(),
+        )
+        _prev_recorder = install_recorder(schedule_sanitizer.recorder)
+
     # Graceful preemption (TPU VMs are frequently preemptible, typically
     # with a ~30 s SIGTERM grace window): the flag is checked inside the
     # STEP loop, so the save happens within seconds, not at the end of a
@@ -619,6 +636,10 @@ def _train_impl(
                     # for every collective the step traced
                     # (obs/comms.py) — static values, no syncs
                     payload.update(comms.payload())
+                    if schedule_sanitizer is not None:
+                        # schedule hash on every line: dashboards watch
+                        # it for FLATNESS (like compile_cache_misses)
+                        payload.update(schedule_sanitizer.recorder.payload())
                     if fleet is not None:
                         # cross-host aggregation: EVERY process
                         # contributes its vector (this is a
@@ -646,6 +667,12 @@ def _train_impl(
                         handle_alerts(
                             gstep, epoch, engine.observe(gstep, payload)
                         )
+                    if schedule_sanitizer is not None:
+                        # publish + cross-check AFTER the line is
+                        # durable: a divergence abort must leave the
+                        # metrics tail (and the hash) on disk
+                        writer.fsync()
+                        schedule_sanitizer.check(gstep)
                     if recompile_guard is not None:
                         diagnosis = recompile_guard.update(gstep, misses)
                         if diagnosis is not None:
@@ -766,6 +793,10 @@ def _train_impl(
                     )
                     break
     finally:
+        if schedule_sanitizer is not None:
+            from moco_tpu.analysis.sanitizer import install_recorder
+
+            install_recorder(_prev_recorder)
         if profile_window is not None:
             profile_window.close()  # stop a still-open capture window
         if wd is not None:

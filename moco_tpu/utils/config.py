@@ -240,6 +240,16 @@ class TrainConfig:
     # variants); a compile-cache miss after this aborts under
     # --strict-tracing.
     recompile_warmup_steps: int = 8
+    # Runtime collective-schedule sanitizer (mocolint runtime arm,
+    # analysis/sanitizer.py, --sanitize-collectives): every comms-tagged
+    # collective site records its (site, kind, operand-shape) into a
+    # per-process schedule; on log steps the schedule hash is published
+    # out-of-band (schedule.p<i>.json, heartbeat-style) and cross-checked
+    # against every peer. A mismatch aborts with a per-site diff BEFORE
+    # the pod deadlocks in the mismatched collective. Off the hot path
+    # (recording happens at trace time; the check piggybacks on the log
+    # step's host sync).
+    sanitize_collectives: bool = False
     # -- telemetry (moco_tpu/obs) ---------------------------------------
     # Metric sinks, comma list from the obs sink registry ("jsonl",
     # "csv", "tensorboard"); the JSONL sink is always included — the
@@ -338,7 +348,7 @@ def config_from_dict(d: dict) -> TrainConfig:
                 "seed", "workdir", "log_every", "checkpoint_every_epochs",
                 "checkpoint_async", "checkpoint_keep", "steps_per_epoch",
                 "nan_guard_threshold", "watchdog_timeout",
-                "strict_tracing", "recompile_warmup_steps",
+                "strict_tracing", "recompile_warmup_steps", "sanitize_collectives",
                 "sinks", "metrics_port", "metrics_host", "health_metrics",
                 "obs_probe_every", "fleet_metrics", "alert_rules", "alerts_fatal",
             )

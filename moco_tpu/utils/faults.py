@@ -29,6 +29,13 @@ Spec grammar: comma-separated faults, each `kind@key=val[:key=val...]`:
                                   — exercises the stall watchdog
     preempt@step=N                SIGTERM this process at global step N
                                   (once) — deterministic preemption
+    diverge@site=S                perturb THIS process's recorded
+                                  collective schedule at comms site S
+                                  (analysis/sanitizer.py appends a
+                                  divergence marker to the site's shape
+                                  signature) — exercises the runtime
+                                  collective-schedule sanitizer without
+                                  a real divergent pod
 
 Example:
     MOCO_FAULTS="ckpt_truncate@step=8,io@site=data.read:at=3,nan@step=6"
@@ -48,7 +55,7 @@ import time
 from collections import Counter
 from typing import Optional
 
-KINDS = ("ckpt_truncate", "io", "nan", "stall", "preempt", "delay")
+KINDS = ("ckpt_truncate", "io", "nan", "stall", "preempt", "delay", "diverge")
 
 _INT_KEYS = ("step", "at", "times")
 _FLOAT_KEYS = ("seconds",)
@@ -136,6 +143,16 @@ class FaultPlan:
             if kind == "preempt" and p["step"] == step and self._fire_once(i):
                 print(f"injected fault: SIGTERM self at step {step}", flush=True)
                 os.kill(os.getpid(), signal.SIGTERM)
+
+    def diverge_marker(self, site: str) -> str:
+        """Non-empty divergence marker when a `diverge@site=S` rule
+        targets this comms site — the schedule recorder appends it to
+        the site's shape signature, making THIS process's schedule hash
+        differ deterministically."""
+        for kind, p in self.rules:
+            if kind == "diverge" and p.get("site") == site:
+                return "#diverged"
+        return ""
 
     def on_checkpoint_saved(self, directory: str, step: int, wait=None) -> None:
         for i, (kind, p) in enumerate(self.rules):
@@ -232,6 +249,12 @@ def maybe_stall(step: int) -> None:
 def maybe_preempt(step: int) -> None:
     if _PLAN is not None:
         _PLAN.maybe_preempt(step)
+
+
+def diverge_marker(site: str) -> str:
+    if _PLAN is not None:
+        return _PLAN.diverge_marker(site)
+    return ""
 
 
 def on_checkpoint_saved(directory: str, step: int, wait=None) -> None:

@@ -65,6 +65,43 @@ def enable_persistent_compilation_cache(path: str | None = None) -> None:
         pass
 
 
+def backend_probe(timeout: int = 180) -> tuple[bool, str | None]:
+    """(usable, reason-if-not) for the default accelerator backend —
+    the reasoned form of :func:`backend_usable`, so callers (bench.py)
+    can RECORD why an accelerator leg was skipped instead of silently
+    degrading (BENCH r02–r05 all fell back to the CPU smoke with no
+    trace of why; the perf trajectory went blind)."""
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        return True, None
+    import subprocess
+    import sys
+
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import jax; jax.devices()"],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.PIPE,
+    )
+    try:
+        rc = proc.wait(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        # abandoned, never killed — see backend_usable's docstring
+        return False, (
+            f"backend probe hung > {timeout}s (jax.devices() never returned; "
+            "busy chip or wedged tunnel lease)"
+        )
+    if rc == 0:
+        return True, None
+    err = b""
+    try:
+        if proc.stderr is not None:
+            err = proc.stderr.read() or b""
+    except Exception:
+        pass
+    tail = err.decode("utf-8", "replace").strip().splitlines()
+    detail = tail[-1][:200] if tail else "no stderr"
+    return False, f"backend probe failed (exit {rc}): {detail}"
+
+
 def backend_usable(timeout: int = 180) -> bool:
     """Probe the default accelerator backend in a SUBPROCESS with a
     timeout; True when `jax.devices()` succeeds there.
@@ -79,17 +116,4 @@ def backend_usable(timeout: int = 180) -> bool:
     A CPU-pinned environment short-circuits to True (the caller's
     `pin_platform_from_env` makes CPU init safe and instant).
     """
-    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
-        return True
-    import subprocess
-    import sys
-
-    proc = subprocess.Popen(
-        [sys.executable, "-c", "import jax; jax.devices()"],
-        stdout=subprocess.DEVNULL,
-        stderr=subprocess.DEVNULL,
-    )
-    try:
-        return proc.wait(timeout=timeout) == 0
-    except subprocess.TimeoutExpired:
-        return False
+    return backend_probe(timeout)[0]

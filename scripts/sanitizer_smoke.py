@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""Collective-schedule sanitizer smoke: prove the runtime divergence
+detector end-to-end on a fake-8-device mesh, asserted hard.
+
+    python scripts/sanitizer_smoke.py [--workdir DIR]
+
+Two legs over the SAME real collective schedule (the a2a Shuffle-BN
+exchange + a grad-style psum + the queue's key all_gather, traced
+through `obs/comms.py` tags on an 8-virtual-device mesh):
+
+1. **control** — two simulated processes record the schedule cleanly;
+   their hashes agree, `ScheduleSanitizer.check()` passes, and the
+   driver-level run (`--sanitize-collectives` equivalent) writes
+   `collective_schedule_hash` on its metrics lines. Exit contribution:
+   0.
+2. **chaos** — process 1 re-records under an injected
+   `diverge@site=shuffle.a2a` fault (`utils/faults.py`). Its hash must
+   differ, `check()` must raise `ScheduleDivergenceError`, the message
+   must carry a PER-SITE diff naming `shuffle.a2a`, and
+   `schedule_diff.json` must land on disk (the CI artifact).
+
+The smoke exits nonzero if the detector misses the divergence OR
+false-positives on the clean leg.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+# 8 virtual CPU devices, pinned BEFORE jax initializes (same trick as
+# tests/conftest.py and scripts/fleet_smoke.py).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+DIVERGE_SITE = "shuffle.a2a"
+
+
+def trace_schedule(process_index: int) -> "ScheduleRecorder":
+    """Trace the real collective schedule into a fresh recorder
+    simulating one process: shuffle a2a + unshuffle + key all_gather +
+    grad psum, all comms-tagged, on the 8-device mesh. A fresh
+    shard_map closure per call forces a fresh trace so the tags
+    re-fire."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from moco_tpu.analysis.sanitizer import ScheduleRecorder, install_recorder
+    from moco_tpu.obs import comms
+    from moco_tpu.parallel.compat import shard_map
+    from moco_tpu.parallel.shuffle import (
+        balanced_shuffle,
+        balanced_unshuffle,
+        unshuffle_gather,
+    )
+
+    recorder = ScheduleRecorder(process_index=process_index)
+    prev = install_recorder(recorder)
+    try:
+        import numpy as np
+
+        devices = jax.devices()
+        mesh = Mesh(np.array(devices), ("data",))
+        n = len(devices)
+
+        def step(x, rng):
+            y = balanced_shuffle(rng, x, "data")
+            k = y * 2.0
+            k = balanced_unshuffle(rng, k, "data")  # mocolint: disable=JX003  (involution reuses the key on purpose, same contract as parallel/shuffle.py)
+            _, k_global = unshuffle_gather(k, jnp.argsort(jnp.arange(x.shape[0] * n)), "data")
+            with comms.tag("grad.psum", "psum", k, n):
+                g = lax.psum(k, "data")
+            return g + k_global.sum()
+
+        fn = shard_map(
+            step, mesh=mesh,
+            in_specs=(P("data"), P()), out_specs=P("data"),
+            check_vma=False,  # nested-pjit rep inference trips on 0.4.x
+        )
+        x = jnp.arange(16 * n * 4, dtype=jnp.float32).reshape(16 * n, 4)
+        rng = jax.random.PRNGKey(0)
+        jax.block_until_ready(jax.jit(fn)(x, rng))
+    finally:
+        install_recorder(prev)
+    return recorder
+
+
+def run_smoke(workdir: str) -> dict:
+    from moco_tpu.analysis.sanitizer import (
+        ScheduleDivergenceError,
+        ScheduleSanitizer,
+    )
+    from moco_tpu.utils import faults
+
+    report: dict = {"workdir": workdir}
+
+    # ---- leg 1: clean control ----------------------------------------
+    faults.clear()
+    rec0 = trace_schedule(0)
+    rec1 = trace_schedule(1)
+    assert rec0.entries(), "no collective sites recorded — tag hook broken"
+    sites = [e[0] for e in rec0.entries()]
+    assert DIVERGE_SITE in sites, f"expected {DIVERGE_SITE!r} in {sites}"
+    assert rec0.schedule_hash() == rec1.schedule_hash(), (
+        "clean re-trace hashed differently — recorder is not deterministic"
+    )
+    san0 = ScheduleSanitizer(workdir, process_index=0, num_processes=2, recorder=rec0)
+    san1 = ScheduleSanitizer(workdir, process_index=1, num_processes=2, recorder=rec1)
+    san1.publish(step=0)
+    san0.check(step=0)  # must NOT raise
+    san1.check(step=0)
+    report["control"] = {
+        "hash": rec0.schedule_hash()[:12],
+        "sites": sites,
+        "ok": True,
+    }
+    print(f"control: {len(sites)} sites agree, hash {rec0.schedule_hash()[:12]}")
+
+    # ---- leg 2: injected divergence ----------------------------------
+    faults.install(f"diverge@site={DIVERGE_SITE}")
+    try:
+        rec1_div = trace_schedule(1)
+    finally:
+        faults.clear()
+    assert rec1_div.schedule_hash() != rec0.schedule_hash(), (
+        "diverge@ fault did not change the schedule hash"
+    )
+    san1_div = ScheduleSanitizer(
+        workdir, process_index=1, num_processes=2, recorder=rec1_div
+    )
+    caught = None
+    try:
+        san1_div.check(step=1)
+    except ScheduleDivergenceError as e:
+        caught = str(e)
+    assert caught is not None, "sanitizer MISSED the injected divergence"
+    assert DIVERGE_SITE in caught, (
+        f"divergence message lacks the per-site diff naming {DIVERGE_SITE!r}:\n{caught}"
+    )
+    diff_path = os.path.join(workdir, "schedule_diff.json")
+    assert os.path.exists(diff_path), "schedule_diff.json artifact missing"
+    with open(diff_path) as f:
+        diff = json.load(f)
+    assert diff["divergent_peers"] == [0], diff["divergent_peers"]
+    assert any(DIVERGE_SITE in line for line in diff["diff"]), diff["diff"]
+    report["chaos"] = {
+        "hash": rec1_div.schedule_hash()[:12],
+        "caught": True,
+        "diff_lines": diff["diff"],
+    }
+    print(f"chaos: divergence at {DIVERGE_SITE!r} caught with per-site diff:")
+    for line in diff["diff"]:
+        print(f"  {line}")
+    return report
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--workdir", default=None,
+        help="artifact directory (default: a fresh temp dir)",
+    )
+    args = ap.parse_args()
+    workdir = args.workdir or tempfile.mkdtemp(prefix="sanitizer_smoke_")
+    os.makedirs(workdir, exist_ok=True)
+    report = run_smoke(workdir)
+    with open(os.path.join(workdir, "sanitizer_smoke.json"), "w") as f:
+        json.dump(report, f, indent=2)
+    print(f"sanitizer smoke OK — artifacts in {workdir}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,27 @@
+"""Seeded CROSS-FUNCTION stop_gradient violation (the interprocedural
+JX005 acceptance fixture): the key-encoder taint enters through one
+helper's return and reaches the einsum sink inside ANOTHER helper —
+both call sites look innocent to a per-function pass."""
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def encode(params, x):
+    return x @ params["w"]
+
+
+def project(q, k):
+    return jnp.einsum("nc,kc->nk", q, k)
+
+
+def bad_loss(params_q, params_k, batch):
+    q = encode(params_q, batch)
+    k = encode(params_k, batch)  # tainted THROUGH encode's summary
+    return project(q, k)  # expect: JX005
+
+
+def good_loss(params_q, params_k, batch):
+    q = encode(params_q, batch)
+    k = lax.stop_gradient(encode(params_k, batch))
+    return project(q, k)

@@ -1,0 +1,44 @@
+"""Known-bad fixture for JX008: collectives issued under host-local
+control flow — the SPMD divergence/deadlock bug class."""
+
+import time
+
+import jax
+from jax import lax
+
+
+def gather_when_retrying(x, io_retries):
+    if io_retries > 0:  # per-host counter: hosts disagree
+        return lax.all_gather(x, "data")  # expect: JX008
+    return x
+
+
+def reduce_on_host0(x):
+    idx = jax.process_index()
+    if idx == 0:
+        return lax.psum(x, "data")  # expect: JX008
+    return x
+
+
+def reduce_on_wall_clock(x, deadline):
+    if time.monotonic() < deadline:
+        return lax.pmean(x, "data")  # expect: JX008
+    return x
+
+
+def gather_in_handler(x, loader):
+    try:
+        y = loader(x)
+    except ValueError:
+        y = lax.all_gather(x, "data")  # expect: JX008
+    return y
+
+
+def issue_reduce(x):
+    return lax.psum(x, "data")
+
+
+def helper_under_host_branch(x):
+    if jax.process_index() == 0:
+        return issue_reduce(x)  # expect: JX008
+    return x

@@ -1,0 +1,26 @@
+"""Known-good fixture for JX008: collectives keyed on replicated state,
+and host-local branching that issues NO collective (the correct idioms,
+straight from the PR-4 fix: the fleet gather rides the log schedule)."""
+
+import jax
+from jax import lax
+
+
+def gather_on_log_schedule(x, step, log_every):
+    if step % log_every == 0:  # every host computes the same schedule
+        return lax.all_gather(x, "data")
+    return x
+
+
+def host0_logs_after_collective(x):
+    reduced = lax.psum(x, "data")  # unconditional: every host enters
+    if jax.process_index() == 0:
+        summary = float(reduced[0])
+        return reduced, summary
+    return reduced, None
+
+
+def retry_counter_stays_local(x, io_retries):
+    if io_retries > 0:
+        x = x * 0.0  # host-local branch, but no collective inside
+    return lax.pmean(x, "data")

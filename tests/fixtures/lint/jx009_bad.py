@@ -1,0 +1,26 @@
+"""Known-bad fixture for JX009: bf16 operands reaching matmul/einsum/
+psum sinks without f32 accumulation."""
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def bad_matmul(x, w):
+    xb = x.astype(jnp.bfloat16)
+    wb = w.astype(jnp.bfloat16)
+    return jnp.matmul(xb, wb)  # expect: JX009
+
+
+def bad_einsum(q, k):
+    qb = q.astype(jnp.bfloat16)
+    return jnp.einsum("nc,kc->nk", qb, k)  # expect: JX009
+
+
+def bad_operator_matmul(x, w):
+    xb = x.astype("bfloat16")
+    return xb @ w  # expect: JX009
+
+
+def bad_grad_psum(g):
+    gb = g.astype(jnp.bfloat16)
+    return lax.psum(gb, "data")  # expect: JX009

@@ -1,0 +1,27 @@
+"""Known-good fixture for JX009: bf16 wire/compute with f32
+accumulation — preferred_element_type on the matmuls (the repo's kernel
+idiom, ops/fused_infonce.py) and cast-up-before-psum."""
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def good_matmul(x, w):
+    xb = x.astype(jnp.bfloat16)
+    wb = w.astype(jnp.bfloat16)
+    return jnp.matmul(xb, wb, preferred_element_type=jnp.float32)
+
+
+def good_einsum(q, k):
+    qb = q.astype(jnp.bfloat16)
+    return jnp.einsum("nc,kc->nk", qb, k, preferred_element_type=jnp.float32)
+
+
+def good_psum_cast_up(g):
+    gb = g.astype(jnp.bfloat16)
+    g32 = gb.astype(jnp.float32)
+    return lax.psum(g32, "data")
+
+
+def f32_throughout(x, w):
+    return jnp.matmul(x, w)

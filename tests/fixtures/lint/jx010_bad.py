@@ -1,0 +1,35 @@
+"""Known-bad fixture for JX010: helper-issued collectives whose axis
+disagrees with the enclosing shard_map declaration — invisible to the
+lexical JX007 because the collective lives in the helper."""
+
+from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+MODEL_AXIS = "model"
+
+
+def helper_reduce(x):
+    return lax.psum(x, MODEL_AXIS)
+
+
+def step(x):
+    return helper_reduce(x)  # expect: JX010
+
+
+def build(mesh):
+    return shard_map(step, mesh=mesh, in_specs=(P("data"),), out_specs=P("data"))
+
+
+def helper_param_axis(x, axis_name):
+    return lax.all_gather(x, axis_name)
+
+
+def step_binds_wrong_axis(x):
+    return helper_param_axis(x, "rows")  # expect: JX010
+
+
+def build2(mesh):
+    return shard_map(
+        step_binds_wrong_axis, mesh=mesh, in_specs=(P("data"),), out_specs=P("data")
+    )

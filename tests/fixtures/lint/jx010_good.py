@@ -1,0 +1,36 @@
+"""Known-good fixture for JX010: helper-issued collectives whose axis
+agrees with the shard_map declaration — via a constant, and via an
+axis-name parameter bound correctly at the call site (the
+parallel/shuffle.py idiom)."""
+
+from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+DATA_AXIS = "data"
+
+
+def helper_reduce(x):
+    return lax.psum(x, DATA_AXIS)
+
+
+def step(x):
+    return helper_reduce(x)
+
+
+def build(mesh):
+    return shard_map(step, mesh=mesh, in_specs=(P(DATA_AXIS),), out_specs=P(DATA_AXIS))
+
+
+def helper_param_axis(x, axis_name):
+    return lax.all_gather(x, axis_name)
+
+
+def step_binds_declared_axis(x):
+    return helper_param_axis(x, DATA_AXIS)
+
+
+def build2(mesh):
+    return shard_map(
+        step_binds_declared_axis, mesh=mesh, in_specs=(P("data"),), out_specs=P("data")
+    )

@@ -1,0 +1,26 @@
+"""Known-bad fixture for JX011: threads with no join-on-close and
+blocking puts with no poison-pill path (the producer-leak shape PR 5
+fixed in data/pipeline.py)."""
+
+import queue
+import threading
+
+
+class LeakyProducer:
+    def __init__(self, src):
+        self._src = src
+        self._q = queue.Queue(maxsize=4)
+        self._thread = threading.Thread(target=self._run, daemon=True)  # expect: JX011
+        self._thread.start()
+
+    def _run(self):
+        for item in self._src:
+            self._q.put(item)  # expect: JX011
+
+    def close(self):
+        # drains nothing, joins nothing: a put-blocked producer hangs here
+        self._src = None
+
+
+def fire_and_forget(fn):
+    threading.Thread(target=fn, daemon=True).start()  # expect: JX011

@@ -1,0 +1,41 @@
+"""Known-good fixture for JX011: the repo-idiomatic shutdown contract —
+responsive put (timeout + stop flag), drain-then-join close()
+(data/pipeline.py's _PrefetchIterator shape)."""
+
+import queue
+import threading
+
+
+class JoinedProducer:
+    def __init__(self, src):
+        self._q = queue.Queue(maxsize=4)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, args=(src,), daemon=True, name="producer"
+        )
+        self._thread.start()
+
+    def _run(self, src):
+        for item in src:
+            while not self._stop.is_set():
+                try:
+                    self._q.put(item, timeout=0.1)  # responsive to close()
+                    break
+                except queue.Full:
+                    continue
+
+    def close(self, timeout=5.0):
+        self._stop.set()
+        while True:
+            try:
+                self._q.get_nowait()  # unblock a put-blocked producer
+            except queue.Empty:
+                break
+        self._thread.join(timeout=timeout)
+
+
+def scoped_worker(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    fn()
+    t.join()

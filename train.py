@@ -163,6 +163,15 @@ def build_parser() -> argparse.ArgumentParser:
         "(first trace); a compile-cache miss after this aborts (default 8)",
     )
     p.add_argument(
+        "--sanitize-collectives", action="store_true", default=None,
+        help="mocolint runtime arm: record every comms-tagged collective "
+        "site's (site, kind, operand-shape) schedule, publish its hash "
+        "out-of-band on log steps (schedule.p<i>.json), cross-check "
+        "against every peer process, and abort with a per-site diff on "
+        "divergence — BEFORE the pod deadlocks in the mismatched "
+        "collective",
+    )
+    p.add_argument(
         "--faults", default=None,
         help="deterministic fault-injection spec (chaos testing), e.g. "
         "'ckpt_truncate@step=8,io@site=data.read:at=3,nan@step=6' — "
@@ -327,6 +336,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         nan_guard_threshold=args.nan_guard_threshold,
         strict_tracing=args.strict_tracing,
         recompile_warmup_steps=args.recompile_warmup,
+        sanitize_collectives=args.sanitize_collectives,
         sinks=args.sinks,
         metrics_port=args.metrics_port,
         metrics_host=args.metrics_host,
