@@ -116,7 +116,13 @@ def main() -> None:
     # ledger ships inside the one-line JSON as `legs`.
     legs: dict[str, dict] = {
         name: {"ran": False, "skip_reason": None}
-        for name in ("accelerator", "numerics_crosscheck", "obs_overhead", "with_data")
+        for name in (
+            "accelerator",
+            "numerics_crosscheck",
+            "obs_overhead",
+            "with_data",
+            "zero_ab",
+        )
     }
 
     def _skip(leg: str, reason: str) -> None:
@@ -389,6 +395,82 @@ def main() -> None:
         except Exception as e:
             _skip("obs_overhead", f"leg crashed: {e!r:.200}")
 
+    # ---- ZeRO weight-update sharding A/B (zero1 vs zero23) ------------
+    # Same model/batch, two extra compiled steps: stage 1 (sharded opt
+    # state, params re-gathered in-step) vs stage 2/3 (persistently
+    # sharded params, bucketed collectives). Recorded per leg: rate,
+    # device hbm peak where the backend reports it (NB: peak is a
+    # process-lifetime high-water mark, tainted by the main loop above —
+    # the analytic at-rest state bytes are the clean A/B signal), and
+    # the analytic comms bytes/step from the per-bucket ledger.
+    zero_ab = None
+    if os.environ.get("BENCH_SKIP_ZERO"):
+        _skip("zero_ab", "BENCH_SKIP_ZERO set")
+    elif n_dev < 2:
+        _skip(
+            "zero_ab",
+            f"single-device mesh ({n_dev} chip): ZeRO shards over the data axis "
+            "(scripts/fleet_smoke.py covers the fake-8-device A/B)",
+        )
+    else:
+        try:
+            import dataclasses as _dcz
+
+            from moco_tpu.obs import comms as _comms
+            from moco_tpu.obs.stepstats import device_memory_stats, tree_shard_bytes
+
+            zero_ab = {}
+            zsteps = max(steps // 2, 2)
+            for name, stage in (("zero1", 1), ("zero23", 3)):
+                cfg_z = _dcz.replace(
+                    config,
+                    parallel=_dcz.replace(
+                        config.parallel, shard_weight_update=True, zero_stage=stage
+                    ),
+                )
+                state_z = create_state(  # mocolint: disable=JX003  (A/B legs share the main run's init seed on purpose: identical weights across zero1/zero23)
+                    rng, cfg_z, encoder, tx,
+                    jnp.zeros((1, img, img, 3), jnp.float32),
+                    predictor=predictor, zero_num_data=n_dev,
+                )
+                step_z = make_train_step(
+                    cfg_z, encoder, tx, mesh, donate=False, predictor=predictor,
+                    total_steps=5004 * config.optim.epochs, state_template=state_z,
+                )
+                state_z = place_state(
+                    state_z, mesh, zero=True, zero_params=stage >= 2
+                )
+                _comms.reset()  # per-leg ledger; tags re-fire on the fresh trace
+                st = state_z
+                for _ in range(2):
+                    st, m = step_z(st, batch_dict, root_rng)
+                float(m["loss"])
+                t0z = time.perf_counter()
+                for _ in range(zsteps):
+                    st, m = step_z(st, batch_dict, root_rng)
+                float(m["loss"])
+                dtz = time.perf_counter() - t0z
+                mem = device_memory_stats() or {}
+                ledger = _comms.payload()
+                zero_ab[name] = {
+                    "imgs_per_sec_per_chip": round(batch * zsteps / dtz / n_dev, 2),
+                    "hbm_peak_bytes": mem.get("hbm_peak_bytes"),
+                    "hbm_state_bytes_per_chip": tree_shard_bytes(st),
+                    "comms_bytes_per_step": ledger.get("comms/total", 0),
+                }
+            legs["zero_ab"]["ran"] = True
+            saved = (
+                zero_ab["zero1"]["hbm_state_bytes_per_chip"]
+                - zero_ab["zero23"]["hbm_state_bytes_per_chip"]
+            )
+            print(
+                f"zero A/B: zero1={zero_ab['zero1']} zero23={zero_ab['zero23']} "
+                f"(at-rest state saved/chip: {saved / 1e6:.1f} MB)",
+                file=sys.stderr,
+            )
+        except Exception as e:
+            _skip("zero_ab", f"leg crashed: {e!r:.200}")
+
     # ---- MFU (per-device FLOPs over per-device peak) ------------------
     flops_per_dev = _step_flops(step, state, batch_dict, root_rng) or (
         None if is_vit else _analytic_step_flops(batch, img) / n_dev
@@ -555,6 +637,10 @@ def main() -> None:
                 # telemetry-layer cost: full obs (health gauges + tracer
                 # + sink writes) vs bare, same compiled shapes
                 "obs_overhead_pct": obs_overhead_pct,
+                # ZeRO-1 vs ZeRO-2/3 A/B (multi-chip legs only): per-leg
+                # rate, device hbm peak, analytic at-rest state bytes,
+                # and bucketed-collective bytes/step
+                "zero_ab": zero_ab,
                 # per-leg skip ledger: WHY a leg didn't run, in-band —
                 # a BENCH_*.json degraded to the CPU smoke now says so
                 # itself (accelerator.skip_reason) instead of relying on

@@ -2,12 +2,17 @@ from moco_tpu.core.ema import ema_update
 from moco_tpu.core.moco import (
     MoCoEncoder,
     MocoState,
+    Zero23TrainStep,
+    ZeroGathered,
     build_encoder,
     build_predictor,
     create_state,
+    full_param_shapes,
     make_train_step,
     place_state,
+    reshard_state,
     state_specs,
+    zero_stage23,
 )
 from moco_tpu.core.queue import check_queue_divisibility, enqueue, init_queue
 
@@ -15,12 +20,17 @@ __all__ = [
     "ema_update",
     "MoCoEncoder",
     "MocoState",
+    "Zero23TrainStep",
+    "ZeroGathered",
     "build_encoder",
     "build_predictor",
     "create_state",
+    "full_param_shapes",
     "make_train_step",
     "place_state",
+    "reshard_state",
     "state_specs",
+    "zero_stage23",
     "check_queue_divisibility",
     "enqueue",
     "init_queue",

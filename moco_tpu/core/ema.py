@@ -6,6 +6,12 @@ key forward. There it relies on DDP keeping every rank's `encoder_q`
 bit-identical so the per-rank local EMA stays in lockstep; here the state
 is functional and threaded through the jitted step, so lockstep is
 structural, not a protocol invariant.
+
+Because the update is elementwise, it is layout-agnostic: ZeRO-2/3
+(parallel/zero.py stage 2/3) calls the same function on the persistent
+(m,)-row param SHARDS inside the gather stage — each replica advances
+its own rows and the EMA costs zero collectives, one of the points of
+persistently sharding both encoders in the same layout.
 """
 
 from __future__ import annotations

@@ -30,6 +30,7 @@ from typing import Any, Callable, Optional
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from flax import struct
 from jax import lax
@@ -50,7 +51,14 @@ from moco_tpu.parallel.shuffle import (
     shuffle_gather,
     unshuffle_gather,
 )
-from moco_tpu.parallel.zero import shard_template, sharded_update
+from moco_tpu.parallel.zero import (
+    BucketPlan,
+    expand_opt_state,
+    shard_template,
+    shard_tree,
+    sharded_update,
+    squeeze_opt_state,
+)
 from moco_tpu.utils.config import MocoConfig, TrainConfig
 
 
@@ -229,6 +237,47 @@ class MocoState(struct.PyTreeNode):
     batch_stats_pred: Any = struct.field(default_factory=dict)
 
 
+class ZeroGathered(struct.PyTreeNode):
+    """Output of the ZeRO-2/3 per-step params gather (parallel/zero.py
+    stage 2/3): the FULL trainable params + key-encoder params step k
+    consumes (replicated, donated to the step so XLA frees them after
+    the backward), plus the already-EMA'd key-encoder SHARDS that
+    become step k's `params_k` — the EMA itself ran shard-local inside
+    the gather, with no collective."""
+
+    trainable: Any  # {"enc": ..., "pred": ...}, full shapes, replicated
+    params_k: Any  # full enc-shaped tree, replicated
+    shards_k: Any  # (n, m) persistent layout, P(data)-sharded
+
+
+def zero_stage23(config: TrainConfig) -> bool:
+    """Whether the config selects the persistently-sharded-params ZeRO
+    stage (2 and 3 both map to the one implementation)."""
+    return config.parallel.shard_weight_update and config.parallel.zero_stage >= 2
+
+
+def full_param_shapes(config: TrainConfig, encoder: MoCoEncoder, predictor=None) -> dict:
+    """Abstract (ShapeDtypeStruct) trees of the FULL trainable params —
+    the shape source the ZeRO-2/3 bucket plans, eval-side gathers, and
+    reshard templates all derive from (the persistent (n, m) layout
+    does not carry the original leaf shapes)."""
+    sample = jnp.zeros(
+        (1, config.data.image_size, config.data.image_size, 3), jnp.float32
+    )
+    enc = jax.eval_shape(
+        lambda r: encoder.init(r, sample, train=False), jax.random.PRNGKey(0)
+    )["params"]
+    pred = {}
+    if predictor is not None:
+        pred = jax.eval_shape(
+            lambda r: predictor.init(
+                r, jnp.zeros((1, config.moco.dim), jnp.float32), train=False
+            ),
+            jax.random.PRNGKey(0),
+        )["params"]
+    return {"enc": enc, "pred": pred}
+
+
 def create_state(
     rng: jax.Array,
     config: TrainConfig,
@@ -267,38 +316,48 @@ def create_state(
         pv = predictor.init(pred_rng, jnp.zeros((1, cfg.dim), jnp.float32), train=False)
         params_pred = pv["params"]
         stats_pred = pv.get("batch_stats", {})
+    # opt state always initializes from the FULL trainable shapes (the
+    # (n, m) template is derived from them); the param trees themselves
+    # additionally move to the persistent sharded layout at stage 2/3
+    zero = config.parallel.shard_weight_update and zero_num_data
+    stage23 = bool(zero) and config.parallel.zero_stage >= 2
+    params_k = jax.tree.map(jnp.copy, params)  # moco/builder.py:~L32-36
+    opt_state = tx.init(
+        {"enc": params, "pred": params_pred}
+        if not zero
+        else shard_template({"enc": params, "pred": params_pred}, zero_num_data)
+    )
+    if stage23:
+        params = shard_tree(params, zero_num_data)
+        params_k = shard_tree(params_k, zero_num_data)
+        params_pred = shard_tree(params_pred, zero_num_data)
     return MocoState(
         step=jnp.zeros((), jnp.int32),
         params_q=params,
-        # key encoder initialized as a copy of the query encoder
-        # (moco/builder.py:~L32-36)
-        params_k=jax.tree.map(jnp.copy, params),
+        params_k=params_k,
         batch_stats_q=batch_stats,
         batch_stats_k=jax.tree.map(jnp.copy, batch_stats),
         queue=queue,
         queue_ptr=jnp.zeros((), jnp.int32),
-        # one optimizer over every trainable leaf: encoder_q (+ predictor);
-        # with sharded weight update the state lives in the (n, m)
-        # sharded-flat layout instead
-        opt_state=tx.init(
-            {"enc": params, "pred": params_pred}
-            if not (config.parallel.shard_weight_update and zero_num_data)
-            else shard_template({"enc": params, "pred": params_pred}, zero_num_data)
-        ),
+        opt_state=opt_state,
         params_pred=params_pred,
         batch_stats_pred=stats_pred,
     )
 
 
 def state_specs(
-    shard_queue_over_model: bool, zero_opt_state: Optional[Any] = None
+    shard_queue_over_model: bool,
+    zero_opt_state: Optional[Any] = None,
+    zero_params: bool = False,
 ) -> MocoState:
     """PartitionSpec pytree for MocoState: everything replicated except,
     optionally, the queue rows sharded over the model axis (tensor
-    parallelism for very large dictionaries) and — with sharded weight
+    parallelism for very large dictionaries), — with sharded weight
     update — the optimizer state's (n, m) leaves sharded over `data`
     (`zero_opt_state` is a concrete opt-state tree to derive per-leaf
-    specs from; its 2-D leaves are the sharded ones, scalars replicate).
+    specs from; its 2-D leaves are the sharded ones, scalars replicate),
+    and — at ZeRO stage 2/3 (`zero_params`) — the param trees
+    themselves, whose leaves all live in the (n, m) persistent layout.
     """
     qspec = P(MODEL_AXIS, None) if shard_queue_over_model else P()
     opt_spec: Any = P()
@@ -307,16 +366,17 @@ def state_specs(
             lambda x: P(DATA_AXIS, None) if getattr(x, "ndim", 0) == 2 else P(),
             zero_opt_state,
         )
+    pspec = P(DATA_AXIS, None) if zero_params else P()
     return MocoState(
         step=P(),
-        params_q=P(),
-        params_k=P(),
+        params_q=pspec,
+        params_k=pspec,
         batch_stats_q=P(),
         batch_stats_k=P(),
         queue=qspec,
         queue_ptr=P(),
         opt_state=opt_spec,
-        params_pred=P(),
+        params_pred=pspec,
         batch_stats_pred=P(),
     )
 
@@ -387,13 +447,34 @@ def make_train_step(
     if shard_queue_over_model and cfg.num_negatives % (n_model * max(global_batch, 1)):
         raise ValueError("sharded queue requires K % (num_model*global_batch) == 0")
     zero = config.parallel.shard_weight_update
+    zero23 = zero_stage23(config)
     if zero:
+        if config.parallel.zero_stage not in (1, 2, 3):
+            raise ValueError(
+                f"zero_stage must be 1, 2 or 3, got {config.parallel.zero_stage}"
+            )
         if config.optim.optimizer == "lars":
             # LARS trust ratios need whole-tensor norms; a flat shard
             # cannot compute them (moco_tpu/parallel/zero.py docstring)
             raise ValueError("shard_weight_update supports element-wise optimizers only (sgd/adamw), not lars")
         if state_template is None:
             raise ValueError("shard_weight_update needs state_template for the opt-state sharding specs")
+    # ZeRO-2/3 static machinery: the persistent (n, m) layout loses the
+    # original leaf shapes, so the bucket plans (and the in-step
+    # reconstruction of full leaves) derive from an abstract init
+    plan_trainable = plan_enc = None
+    trainable_shapes = None
+    if zero23:
+        trainable_shapes = full_param_shapes(config, encoder, predictor)
+        bucket_bytes = int(config.parallel.zero_bucket_mb * 1024 * 1024)
+        plan_trainable = BucketPlan(
+            jax.tree.leaves(trainable_shapes), n_data, bucket_bytes
+        )
+        plan_enc = BucketPlan(
+            jax.tree.leaves(trainable_shapes["enc"]), n_data, bucket_bytes
+        )
+        _trainable_def = jax.tree.structure(trainable_shapes)
+        _enc_def = jax.tree.structure(trainable_shapes["enc"])
     # Fused streaming InfoNCE (pallas): auto-on for a TPU backend with a
     # replicated, tile-divisible queue; explicit True forces it (interpret
     # mode off-TPU), False forces the dense logits path.
@@ -449,15 +530,72 @@ def make_train_step(
         )
         return out, mut["batch_stats"]
 
-    def v3_step(state: MocoState, batch):
+    def zero23_update(state: MocoState, grads):
+        """ZeRO-2/3 weight update on the persistent shards: bucketed
+        psum_scatter of the full local grads (one collective per fusion
+        bucket, issued as backward produces each bucket's leaves), then
+        the elementwise optimizer on this replica's (m,) rows only. NO
+        trailing all_gather — the params stay sharded; the next step's
+        gather re-materializes them. Returns (old shard trees, new
+        shard trees, expanded opt state)."""
+        grad_leaves, grad_def = jax.tree.flatten(grads)
+        grad_sh = jax.tree.unflatten(
+            grad_def, plan_trainable.scatter_mean(grad_leaves, site="zero.scatter")
+        )
+        trainable_sh = {
+            "enc": squeeze_opt_state(state.params_q),
+            "pred": squeeze_opt_state(state.params_pred),
+        }
+        updates, new_opt = tx.update(
+            grad_sh, squeeze_opt_state(state.opt_state), trainable_sh
+        )
+        new_tr_sh = jax.tree.map(lambda p, u: p + u, trainable_sh, updates)
+        return trainable_sh, new_tr_sh, expand_opt_state(new_opt)
+
+    def gather_core(state: MocoState) -> ZeroGathered:
+        """ZeRO-2/3 step-start stage, hoisted into the pipelined driver
+        so it hides under the previous step's compute: the EMA key
+        update runs SHARD-LOCAL (elementwise on this replica's rows —
+        no collective at all), then one bucketed all_gather per param
+        family re-materializes the full trees the step consumes."""
+        m = ema_momentum(state.step)
+        trainable_sh = {
+            "enc": squeeze_opt_state(state.params_q),
+            "pred": squeeze_opt_state(state.params_pred),
+        }
+        k_sh = ema_update(
+            squeeze_opt_state(state.params_k), trainable_sh["enc"], m
+        )
+        t_leaves, t_def = jax.tree.flatten(trainable_sh)
+        trainable_full = jax.tree.unflatten(
+            t_def, plan_trainable.gather(t_leaves, site="zero.gather_q")
+        )
+        k_leaves, k_def = jax.tree.flatten(k_sh)
+        params_k_full = jax.tree.unflatten(
+            k_def, plan_enc.gather(k_leaves, site="zero.gather_k")
+        )
+        return ZeroGathered(
+            trainable=trainable_full,
+            params_k=params_k_full,
+            shards_k=expand_opt_state(k_sh),
+        )
+
+    def v3_step(state: MocoState, batch, gathered: Optional[ZeroGathered] = None):
         """MoCo v3 (arXiv:2104.02057 alg. 1): symmetric queue-free
         contrastive loss, both views through both encoders, the global
-        batch as negatives, 2τ loss scaling."""
+        batch as negatives, 2τ loss scaling. `gathered` (ZeRO-2/3): the
+        full params arrive pre-gathered (EMA already applied shard-local
+        in the gather stage) and the update writes back to shards."""
         im_q, im_k = batch["im_q"], batch["im_k"]
         local_b = im_q.shape[0]
         x_cat = jnp.concatenate([im_q, im_k], axis=0)
 
-        params_k = ema_update(state.params_k, state.params_q, ema_momentum(state.step))
+        if gathered is None:
+            params_k = ema_update(
+                state.params_k, state.params_q, ema_momentum(state.step)
+            )
+        else:
+            params_k = gathered.params_k
         k_cat, stats_k = apply_encoder(params_k, state.batch_stats_k, x_cat)
         k1, k2 = jnp.split(lax.stop_gradient(l2_normalize(k_cat)), 2, axis=0)
         if n_data > 1:
@@ -483,7 +621,11 @@ def make_train_step(
             loss2, _ = ctr(q2, k1_g)
             return loss1 + loss2, (stats_q, stats_pred, logits, q1)
 
-        trainable = {"enc": state.params_q, "pred": state.params_pred}
+        trainable = (
+            {"enc": state.params_q, "pred": state.params_pred}
+            if gathered is None
+            else gathered.trainable
+        )
         (loss, (stats_q, stats_pred, logits, q1)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(trainable)
@@ -509,11 +651,33 @@ def make_train_step(
         stats_k = lax.pmean(stats_k, DATA_AXIS)
         stats_pred = lax.pmean(stats_pred, DATA_AXIS)
 
-        if zero:
-            # Sharded weight update (parallel/zero.py): psum_scatter
-            # fuses the grad mean-reduction with the 1/n sharding. The
-            # patch-embed freeze is applied to the gathered FULL params
-            # below, so AdamW's decoupled decay cannot move them either.
+        if gathered is not None:
+            # ZeRO-2/3: bucketed psum_scatter + shard-local update; the
+            # params never re-materialize — the next step's gather does.
+            trainable_sh, new_tr_sh, opt_state = zero23_update(state, grads)
+            if cfg.freeze_patch_embed and "patch_embed" in new_tr_sh["enc"].get(
+                "backbone", {}
+            ):
+                # zeroed grads stop the gradient; restoring the OLD
+                # shards also blocks AdamW's decoupled decay — the
+                # shard-level mirror of the stage-1 full-params freeze
+                new_tr_sh["enc"]["backbone"]["patch_embed"] = trainable_sh["enc"][
+                    "backbone"
+                ]["patch_embed"]
+            drift = lambda: obs_health.ema_drift_sharded(
+                new_tr_sh["enc"], squeeze_opt_state(gathered.shards_k), DATA_AXIS
+            )
+            out_params = dict(
+                params_q=expand_opt_state(new_tr_sh["enc"]),
+                params_pred=expand_opt_state(new_tr_sh["pred"]),
+                params_k=gathered.shards_k,
+            )
+        elif zero:
+            # Sharded weight update (parallel/zero.py stage 1):
+            # psum_scatter fuses the grad mean-reduction with the 1/n
+            # sharding. The patch-embed freeze is applied to the
+            # gathered FULL params below, so AdamW's decoupled decay
+            # cannot move them either.
             frozen_pe = (
                 trainable["enc"]["backbone"]["patch_embed"]
                 if cfg.freeze_patch_embed
@@ -525,6 +689,12 @@ def make_train_step(
             )
             if frozen_pe is not None:
                 new_trainable["enc"]["backbone"]["patch_embed"] = frozen_pe
+            drift = lambda: obs_health.ema_drift(new_trainable["enc"], params_k)
+            out_params = dict(
+                params_q=new_trainable["enc"],
+                params_pred=new_trainable["pred"],
+                params_k=params_k,
+            )
         else:
             with comms.tag("grad.psum", "psum", grads, n_data):
                 grads = lax.pmean(grads, DATA_AXIS)
@@ -536,30 +706,35 @@ def make_train_step(
                     jnp.zeros_like, updates["enc"]["backbone"]["patch_embed"]
                 )
             new_trainable = optax.apply_updates(trainable, updates)
+            drift = lambda: obs_health.ema_drift(new_trainable["enc"], params_k)
+            out_params = dict(
+                params_q=new_trainable["enc"],
+                params_pred=new_trainable["pred"],
+                params_k=params_k,
+            )
         if health_on:
             # batch-local stats pmean over data; drift is a function of
-            # replicated params (v3 has no queue, so no staleness gauges)
+            # replicated params — or, at ZeRO stage 2/3, of the shards
+            # with a psum'd norm (v3 has no queue, so no staleness gauges)
             hlocal = {
                 **obs_health.logit_stats_from_dense(logits, labels),
                 **obs_health.feature_stats(q1),
             }
             metrics.update(lax.pmean(hlocal, DATA_AXIS))
-            metrics.update(obs_health.ema_drift(new_trainable["enc"], params_k))
+            metrics.update(drift())
         new_state = state.replace(
             step=state.step + 1,
-            params_q=new_trainable["enc"],
-            params_pred=new_trainable["pred"],
-            params_k=params_k,
             batch_stats_q=stats_q,
             batch_stats_k=stats_k,
             batch_stats_pred=stats_pred,
             opt_state=opt_state,
+            **out_params,
         )
         return new_state, metrics
 
-    def step_fn(state: MocoState, batch, root_rng):
+    def step_fn(state: MocoState, batch, root_rng, gathered: Optional[ZeroGathered] = None):
         if cfg.v3:
-            return v3_step(state, batch)
+            return v3_step(state, batch, gathered=gathered)
         im_q, im_k = batch["im_q"], batch["im_k"]
         local_b = im_q.shape[0]
         # Deterministic per-step randomness, identical on every device:
@@ -569,7 +744,14 @@ def make_train_step(
 
         # (1) EMA momentum update of the key encoder, *before* the key
         # forward, as upstream orders it (moco/builder.py:~L139-141).
-        params_k = ema_update(state.params_k, state.params_q, ema_momentum(state.step))
+        # At ZeRO stage 2/3 both encoders live as shards and the EMA
+        # already ran shard-local inside the gather stage.
+        if gathered is None:
+            params_k = ema_update(
+                state.params_k, state.params_q, ema_momentum(state.step)
+            )
+        else:
+            params_k = gathered.params_k
 
         # (2) Shuffle-BN: compute keys on a batch that contains none of
         # this device's own positives. With bn_virtual_groups the same
@@ -649,7 +831,11 @@ def make_train_step(
                 acc = topk_accuracy(logits, labels)
             return loss, (stats_q, acc, q)
 
-        trainable = {"enc": state.params_q, "pred": state.params_pred}
+        trainable = (
+            {"enc": state.params_q, "pred": state.params_pred}
+            if gathered is None
+            else gathered.trainable
+        )
         (loss, (stats_q, acc, q_feats)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             trainable
         )
@@ -685,14 +871,29 @@ def make_train_step(
         # shard_weight_update — ZeRO-style (parallel/zero.py): the grad
         # psum_scatter replaces the pmean at identical comm volume, the
         # optimizer touches only this replica's 1/n shard, and an
-        # all_gather rebuilds the full params.
-        if zero:
+        # all_gather rebuilds the full params (stage 1) — or never does,
+        # because the params persist as shards (stage 2/3).
+        if gathered is not None:
+            if shard_queue_over_model:
+                grads = lax.pmean(grads, MODEL_AXIS)
+            _, new_tr_sh, opt_state = zero23_update(state, grads)
+            drift = lambda: obs_health.ema_drift_sharded(
+                new_tr_sh["enc"], squeeze_opt_state(gathered.shards_k), DATA_AXIS
+            )
+            out_params = dict(
+                params_q=expand_opt_state(new_tr_sh["enc"]),
+                params_pred=expand_opt_state(new_tr_sh["pred"]),
+                params_k=gathered.shards_k,
+            )
+        elif zero:
             if shard_queue_over_model:
                 grads = lax.pmean(grads, MODEL_AXIS)
             new_trainable, opt_state = sharded_update(
                 tx, grads, state.opt_state, trainable
             )
             params_q = new_trainable["enc"]
+            drift = lambda: obs_health.ema_drift(params_q, params_k)
+            out_params = dict(params_q=params_q, params_k=params_k)
         else:
             grad_axes = (DATA_AXIS, MODEL_AXIS) if shard_queue_over_model else DATA_AXIS
             grad_world = n_data * (n_model if shard_queue_over_model else 1)
@@ -700,6 +901,8 @@ def make_train_step(
                 grads = lax.pmean(grads, grad_axes)
             updates, opt_state = tx.update(grads, state.opt_state, trainable)
             params_q = optax.apply_updates(trainable, updates)["enc"]
+            drift = lambda: obs_health.ema_drift(params_q, params_k)
+            out_params = dict(params_q=params_q, params_k=params_k)
 
         # (6) FIFO enqueue of the global key batch
         # (moco/builder.py:~L62-77); with a model-sharded queue each shard
@@ -742,7 +945,7 @@ def make_train_step(
                 **obs_health.feature_stats(q_h),
             }
             metrics.update(lax.pmean(hlocal, DATA_AXIS))
-            metrics.update(obs_health.ema_drift(params_q, params_k))
+            metrics.update(drift())
             if cfg.num_negatives:
                 metrics.update(
                     obs_health.queue_age(state.step, cfg.num_negatives, global_batch)
@@ -750,28 +953,21 @@ def make_train_step(
 
         new_state = state.replace(
             step=state.step + 1,
-            params_q=params_q,
-            params_k=params_k,
             batch_stats_q=stats_q,
             batch_stats_k=stats_k,
             queue=queue,
             queue_ptr=queue_ptr,
             opt_state=opt_state,
+            **out_params,
         )
         return new_state, metrics
 
     specs = state_specs(
         shard_queue_over_model,
         zero_opt_state=state_template.opt_state if zero else None,
+        zero_params=zero23,
     )
     batch_spec = {"im_q": P(DATA_AXIS), "im_k": P(DATA_AXIS)}
-    sharded = shard_map(
-        step_fn,
-        mesh=mesh,
-        in_specs=(specs, batch_spec, P()),
-        out_specs=(specs, P()),
-        check_vma=False,
-    )
     # Explicit in/out shardings matter: letting jit infer them from a
     # SingleDeviceSharding initial state makes every later call re-lay-out
     # the whole state (~120ms per step through the axon tunnel, measured).
@@ -780,16 +976,72 @@ def make_train_step(
         lambda s: NamedSharding(mesh, s), tree, is_leaf=lambda x: isinstance(x, P)
     )
     state_shardings = to_sharding(specs)
-    jit_kwargs = dict(
-        in_shardings=(state_shardings, to_sharding(batch_spec), NamedSharding(mesh, P())),
+    if not zero23:
+        sharded = shard_map(
+            step_fn,
+            mesh=mesh,
+            in_specs=(specs, batch_spec, P()),
+            out_specs=(specs, P()),
+            check_vma=False,
+        )
+        jit_kwargs = dict(
+            in_shardings=(state_shardings, to_sharding(batch_spec), NamedSharding(mesh, P())),
+            out_shardings=(state_shardings, NamedSharding(mesh, P())),
+        )
+        # Donation halves peak state memory but is pathologically slow through
+        # the axon remote-TPU tunnel (~80ms/call fixed cost, measured); state
+        # buffers are small relative to HBM, so it stays opt-in.
+        if donate:
+            jit_kwargs["donate_argnums"] = 0
+        return jax.jit(sharded, **jit_kwargs)
+
+    # -- ZeRO-2/3: two jitted programs, (gather, step) -------------------
+    gathered_specs = ZeroGathered(
+        trainable=P(), params_k=P(), shards_k=P(DATA_AXIS, None)
+    )
+    gather_sharded = shard_map(
+        gather_core,
+        mesh=mesh,
+        in_specs=(specs,),
+        out_specs=gathered_specs,
+        check_vma=False,
+    )
+    gather_jit = jax.jit(
+        gather_sharded,
+        in_shardings=(state_shardings,),
+        out_shardings=to_sharding(gathered_specs),
+    )
+    step_sharded = shard_map(
+        lambda state, gathered, batch, rng: step_fn(state, batch, rng, gathered=gathered),
+        mesh=mesh,
+        in_specs=(specs, gathered_specs, batch_spec, P()),
+        out_specs=(specs, P()),
+        check_vma=False,
+    )
+    step_kwargs = dict(
+        in_shardings=(
+            state_shardings,
+            to_sharding(gathered_specs),
+            to_sharding(batch_spec),
+            NamedSharding(mesh, P()),
+        ),
         out_shardings=(state_shardings, NamedSharding(mesh, P())),
     )
-    # Donation halves peak state memory but is pathologically slow through
-    # the axon remote-TPU tunnel (~80ms/call fixed cost, measured); state
-    # buffers are small relative to HBM, so it stays opt-in.
-    if donate:
-        jit_kwargs["donate_argnums"] = 0
-    return jax.jit(sharded, **jit_kwargs)
+    # The gathered full params are one-shot by construction: donating
+    # them lets XLA reuse their HBM during the backward, so peak ~
+    # shards + one live gathered copy, never two. CPU lacks donation
+    # support (it would only warn), so gate on the backend.
+    donate_nums = tuple(
+        ([0] if donate else []) + ([1] if jax.default_backend() in ("tpu", "gpu") else [])
+    )
+    if donate_nums:
+        step_kwargs["donate_argnums"] = donate_nums
+    return Zero23TrainStep(
+        gather=gather_jit,
+        step=jax.jit(step_sharded, **step_kwargs),
+        param_shapes=trainable_shapes,
+        bucket_plans={"trainable": plan_trainable, "enc": plan_enc},
+    )
 
 
 def place_state(
@@ -797,12 +1049,16 @@ def place_state(
     mesh: Mesh,
     shard_queue_over_model: bool = False,
     zero: bool = False,
+    zero_params: bool = False,
 ) -> MocoState:
     """device_put the state into the mesh shardings the train step expects.
     `zero=True` shards the (n, m) opt-state leaves over `data` (sharded
-    weight update, parallel/zero.py)."""
+    weight update, parallel/zero.py); `zero_params=True` additionally
+    shards the persistent param trees (ZeRO stage 2/3 layout)."""
     specs = state_specs(
-        shard_queue_over_model, zero_opt_state=state.opt_state if zero else None
+        shard_queue_over_model,
+        zero_opt_state=state.opt_state if zero else None,
+        zero_params=zero_params,
     )
     placed = {}
     for name in state.__dataclass_fields__:
@@ -815,4 +1071,69 @@ def place_state(
             placed[name] = jax.tree.map(
                 lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), value, spec
             )
+    return MocoState(**placed)
+
+
+class Zero23TrainStep:
+    """The ZeRO-2/3 train step as a (gather, step) pair of jitted
+    programs (make_train_step return value when zero_stage >= 2).
+
+    - `gather(state) -> ZeroGathered`: shard-local EMA + the bucketed
+      params all_gather. The pipelined driver runs this on the
+      AsyncParamGather worker so step k+1's gather hides under step k.
+    - `step(state, gathered, batch, rng) -> (state, metrics)`: the SPMD
+      step consuming the pre-gathered full params (donated on backends
+      with donation support).
+
+    Calling the object runs both inline — the un-hoisted schedule —
+    so non-pipelined callers (tests, bench legs) keep the single-callable
+    contract of the classic step.
+    """
+
+    def __init__(self, gather, step, param_shapes, bucket_plans):
+        self.gather = gather
+        self.step = step
+        self.param_shapes = param_shapes  # {"enc": ..., "pred": ...} abstract
+        self.bucket_plans = bucket_plans
+
+    def __call__(self, state, batch, root_rng):
+        return self.step(state, self.gather(state), batch, root_rng)
+
+
+def reshard_state(
+    state_saved: MocoState,
+    live_template: MocoState,
+    full_template: MocoState,
+) -> MocoState:
+    """Host-side layout conversion between ZeRO checkpoint layouts —
+    the "compatible but resharded" resume: zero1 <-> zero23, sharded <->
+    replicated, and data-axis-width changes all route through the flat
+    vector. `live_template` has the target layout's leaf shapes,
+    `full_template` the replicated (true) shapes — needed because the
+    (n, m) layout does not record them. Only the param trees and the
+    optimizer state reshard; every other field passes through."""
+
+    def _conv(saved, live, full):
+        saved_np = np.asarray(saved)
+        live_shape = tuple(live.shape)
+        full_shape = tuple(full.shape)
+        dtype = live.dtype
+        if saved_np.shape == live_shape:
+            return saved_np.astype(dtype)
+        size = int(np.prod(full_shape)) if full_shape else 1
+        flat = saved_np.reshape(-1)[:size]  # strip source padding
+        if live_shape == full_shape:
+            return flat.reshape(full_shape).astype(dtype)
+        n, m = live_shape  # target (n, m) sharded-flat
+        return np.pad(flat, (0, n * m - size)).reshape(n, m).astype(dtype)
+
+    placed = {}
+    for name in state_saved.__dataclass_fields__:
+        value = getattr(state_saved, name)
+        if name in ("params_q", "params_k", "params_pred", "opt_state"):
+            placed[name] = jax.tree.map(
+                _conv, value, getattr(live_template, name), getattr(full_template, name)
+            )
+        else:
+            placed[name] = value
     return MocoState(**placed)

@@ -118,10 +118,23 @@ def load_pretrained_backbone(
     )
     state, _ = mgr.restore(template)
     mgr.close()
-    missing = {k for k in ("backbone", "head") if k not in state.params_q}
+    params_q = state.params_q
+    if config.parallel.shard_weight_update and config.parallel.zero_stage >= 2:
+        # ZeRO-2/3: the checkpoint's params persist as (n, m) flat
+        # shards — one-shot host gather back to the true shapes before
+        # the surgery (full_param_shapes supplies them; the sharded
+        # layout doesn't record leaf shapes). This is the eval-side
+        # unshard every downstream tool (convert_pretrain, eval_lincls,
+        # export) inherits through this loader.
+        from moco_tpu.core.moco import full_param_shapes
+        from moco_tpu.parallel.zero import unshard_tree_host
+
+        shapes = full_param_shapes(config, encoder, predictor)
+        params_q = unshard_tree_host(params_q, shapes["enc"])
+    missing = {k for k in ("backbone", "head") if k not in params_q}
     if missing:
         raise KeyError(f"pretrained params_q missing {missing}")
-    return state.params_q["backbone"], state.batch_stats_q.get("backbone", {}), config
+    return params_q["backbone"], state.batch_stats_q.get("backbone", {}), config
 
 
 def _build_probe_model(config: TrainConfig, num_classes: int):

@@ -54,6 +54,32 @@ def ema_drift(params_q, params_k) -> dict:
     return out
 
 
+def ema_drift_sharded(params_q, params_k, axis_name: str) -> dict:
+    """`ema_drift` over ZeRO-2/3 persistent param SHARDS (each leaf is
+    this replica's (m,) flat rows, inside shard_map): local squared
+    norms psum over the data axis before the sqrt — the zero-padding
+    rows contribute 0, so the gauge equals the replicated one up to
+    reduction order."""
+    from jax import lax
+
+    eps = 1e-12
+    out = {}
+    diff_sq = ref_sq = jnp.zeros((), jnp.float32)
+    for group in params_q:
+        d = lax.psum(
+            _tree_sq_norm(
+                jax.tree.map(lambda q, k: q - k, params_q[group], params_k[group])
+            ),
+            axis_name,
+        )
+        r = lax.psum(_tree_sq_norm(params_q[group]), axis_name)
+        out[f"ema_drift/{group}"] = jnp.sqrt(d) / (jnp.sqrt(r) + eps)
+        diff_sq = diff_sq + d
+        ref_sq = ref_sq + r
+    out["ema_drift"] = jnp.sqrt(diff_sq) / (jnp.sqrt(ref_sq) + eps)
+    return out
+
+
 def logit_stats(pos_logits: jax.Array, neg_logits: jax.Array) -> dict:
     """Mean/std of the positive and negative InfoNCE logits (post-
     temperature). The healthy pattern is a widening pos/neg margin;

@@ -94,6 +94,21 @@ FIELD_VALIDATORS = {
     # device memory gauges (null where the backend lacks memory_stats)
     "hbm_live_bytes": _num_or_null,
     "hbm_peak_bytes": _num_or_null,
+    # remaining HBM at the live watermark (bytes_limit - live; null
+    # where the backend reports no capacity) — the headroom the ZeRO
+    # stages compete on
+    "hbm_headroom_bytes": _num_or_null,
+    # analytic per-device at-rest bytes of the persistent train state
+    # (obs/stepstats.py tree_shard_bytes) — backend-independent, so the
+    # ZeRO-1 vs ZeRO-2/3 memory A/B works on CPU meshes too
+    "hbm_state_bytes": _int_like,
+    # ZeRO-2/3 hoisted-gather overlap efficiency (parallel/zero.py
+    # AsyncParamGather): 1 - wait/duration of the gather-side stall the
+    # worker absorbed off the critical path (the synthetic
+    # delay@site=zero.gather slow collective in the smokes); null when
+    # nothing was absorbed — device-side gather/compute overlap is read
+    # from the merged trace's zero_gather spans
+    "overlap/zero": _num_or_null,
     # MoCo health gauges (obs/health.py)
     "ema_drift": _num_or_null,
     "logit_pos_mean": _num_or_null,

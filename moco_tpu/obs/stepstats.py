@@ -31,6 +31,7 @@ import time
 from typing import Optional
 
 import jax
+import numpy as np
 
 
 class StepTimeProbe:
@@ -117,9 +118,16 @@ def device_memory_stats(device=None) -> Optional[dict]:
     peak = stats.get("peak_bytes_in_use", stats.get("bytes_in_use_peak"))
     if live is None and peak is None:
         return None
+    limit = stats.get("bytes_limit", stats.get("bytes_reservable_limit"))
     return {
         "hbm_live_bytes": int(live) if live is not None else None,
         "hbm_peak_bytes": int(peak) if peak is not None else None,
+        # how much HBM is LEFT at the live watermark — the gauge the
+        # ZeRO-2/3 work exists to raise (more headroom = bigger per-chip
+        # batch); null where the backend reports no capacity
+        "hbm_headroom_bytes": int(limit) - int(live)
+        if limit is not None and live is not None
+        else None,
     }
 
 
@@ -128,8 +136,43 @@ def memory_payload() -> dict:
     backend reports them, explicit nulls (schema-locked) otherwise."""
     stats = device_memory_stats()
     if stats is None:
-        return {"hbm_live_bytes": None, "hbm_peak_bytes": None}
+        return {
+            "hbm_live_bytes": None,
+            "hbm_peak_bytes": None,
+            "hbm_headroom_bytes": None,
+        }
     return stats
 
 
-__all__ = ["StepTimeProbe", "device_memory_stats", "memory_payload"]
+def tree_shard_bytes(tree) -> int:
+    """Analytic per-device bytes of a pytree's PERSISTENT arrays: each
+    leaf contributes its shard size under its actual sharding (a
+    replicated leaf costs its full bytes on every device; a
+    P(data)-sharded ZeRO leaf 1/n). Backend-independent — this is the
+    at-rest state footprint the CPU-mesh smokes compare across ZeRO
+    stages, where `memory_stats` is unavailable."""
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        sharding = getattr(leaf, "sharding", None)
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        itemsize = np.dtype(dtype).itemsize
+        if sharding is not None:
+            try:
+                shard_shape = sharding.shard_shape(tuple(shape))
+                total += int(np.prod(shard_shape, dtype=np.int64)) * itemsize
+                continue
+            except Exception:
+                pass  # exotic shardings: fall through to full bytes
+        total += int(np.prod(shape, dtype=np.int64)) * itemsize
+    return total
+
+
+__all__ = [
+    "StepTimeProbe",
+    "device_memory_stats",
+    "memory_payload",
+    "tree_shard_bytes",
+]

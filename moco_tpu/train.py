@@ -15,6 +15,7 @@ Library entry: `train(config) -> final metrics`. CLI: repo-root
 
 from __future__ import annotations
 
+import dataclasses
 import math
 import os
 import signal
@@ -28,13 +29,22 @@ import jax.numpy as jnp
 import numpy as np
 
 from moco_tpu import obs
-from moco_tpu.core import build_encoder, build_predictor, create_state, make_train_step, place_state
+from moco_tpu.core import (
+    build_encoder,
+    build_predictor,
+    create_state,
+    make_train_step,
+    place_state,
+    reshard_state,
+    zero_stage23,
+)
 from moco_tpu.data.pipeline import TwoCropPipeline
 from moco_tpu.obs import comms
 from moco_tpu.obs.alerts import AlertEngine, FatalAlertError, parse_rules
 from moco_tpu.obs.fleet import FleetAggregator, Heartbeat
 from moco_tpu.obs.sinks import build_sinks, per_process_filename
-from moco_tpu.obs.stepstats import StepTimeProbe, memory_payload
+from moco_tpu.obs.stepstats import StepTimeProbe, memory_payload, tree_shard_bytes
+from moco_tpu.parallel.zero import AsyncParamGather, unshard_tree_host
 from moco_tpu.parallel import create_mesh, create_multislice_mesh, maybe_initialize_multihost
 from moco_tpu.utils import faults, retry
 from moco_tpu.utils.checkpoint import CheckpointManager
@@ -72,6 +82,18 @@ def train(
     global steps [a, b) into `profile_dir` (or `workdir/profile`)
     instead of the whole-run trace a bare `profile_dir` records.
     """
+    # Partitionable threefry, matching tests/conftest.py. With the
+    # default threefry, GSPMD materializes replicated random bits via
+    # cross-device collectives; those ride in data-INDEPENDENT programs
+    # (the device-side augment) that are in flight concurrently with
+    # the step chain — and XLA:CPU launches programs on input-readiness,
+    # so two independent collective programs can interleave in different
+    # per-device orders and deadlock the rendezvous (observed as a
+    # first-step wedge on the 8-virtual-device mesh once ZeRO-2/3's
+    # gather program joined the flight). Partitionable threefry shards
+    # the bit generation instead: no collectives, no race — and it is
+    # the setting the entire test suite already runs under.
+    jax.config.update("jax_threefry_partitionable", True)
     # Deterministic fault injection (chaos harness): MOCO_FAULTS installs
     # a fresh plan per run; unset leaves any programmatic plan (tests)
     # alone. Zero-cost when no plan is installed.
@@ -145,6 +167,7 @@ def _train_impl(
     init_rng, shuffle_rng = jax.random.split(rng)
     sample = jnp.zeros((1, config.data.image_size, config.data.image_size, 3), jnp.float32)
     zero = config.parallel.shard_weight_update
+    zero23 = zero_stage23(config)
     state = create_state(
         init_rng, config, encoder, tx, sample, predictor=predictor,
         zero_num_data=num_data if zero else None,
@@ -171,9 +194,61 @@ def _train_impl(
                     "live config:\n  " + "\n  ".join(diffs)
                 )
 
-        # a corrupt newest checkpoint is quarantined and the next-older
-        # step restores instead (fault-tolerance layer)
-        state, extra = ckpt.restore(state, validate_extra=_check_compat)
+        # Layout-aware restore: the ZeRO layout fields
+        # (shard_weight_update / zero_stage / the ZeRO mesh width) are
+        # "compatible but resharded", not incompatibilities — a
+        # checkpoint in a different layout restores into a template of
+        # ITS OWN layout, then converts host-side (reshard_state).
+        def _layout(z, stage, n):
+            return (bool(z), bool(z) and int(stage) >= 2, int(n) if z else 0)
+
+        saved_extra = ckpt.read_extra()
+        saved_par = (saved_extra.get("config") or {}).get("parallel") or {}
+        saved_zero = bool(saved_par.get("shard_weight_update", zero))
+        # pre-zero_stage checkpoints with a recorded config were stage-1
+        # by definition; a checkpoint with NO recorded config at all is
+        # assumed to match the live layout (the old behavior)
+        saved_stage = int(
+            saved_par.get(
+                "zero_stage",
+                1 if "shard_weight_update" in saved_par else config.parallel.zero_stage,
+            )
+        )
+        saved_n = int(saved_extra.get("num_data") or num_data)
+        live_layout = _layout(zero, config.parallel.zero_stage, num_data)
+        saved_layout = _layout(saved_zero, saved_stage, saved_n)
+        if saved_layout != live_layout:
+            saved_cfg = dataclasses.replace(
+                config,
+                parallel=dataclasses.replace(
+                    config.parallel,
+                    shard_weight_update=saved_zero,
+                    zero_stage=saved_stage,
+                ),
+            )
+            saved_template = create_state(  # mocolint: disable=JX003  (restore TEMPLATE: values are overwritten by the checkpoint read, only shapes matter — key reuse is deliberate)
+                init_rng, saved_cfg, encoder, tx, sample, predictor=predictor,
+                zero_num_data=saved_n if saved_zero else None,
+            )
+            restored, extra = ckpt.restore(saved_template, validate_extra=_check_compat)
+            full_cfg = dataclasses.replace(
+                config,
+                parallel=dataclasses.replace(
+                    config.parallel, shard_weight_update=False
+                ),
+            )
+            full_template = create_state(  # mocolint: disable=JX003  (shape-only template for reshard_state — deliberate key reuse, values never train)
+                init_rng, full_cfg, encoder, tx, sample, predictor=predictor
+            )
+            state = reshard_state(restored, state, full_template)
+            print0(
+                "resume reshard: checkpoint ZeRO layout "
+                f"{saved_layout} -> live {live_layout}"
+            )
+        else:
+            # a corrupt newest checkpoint is quarantined and the next-older
+            # step restores instead (fault-tolerance layer)
+            state, extra = ckpt.restore(state, validate_extra=_check_compat)
         start_epoch = int(extra.get("epoch", 0)) + 1
         print0(f"resumed from epoch {start_epoch - 1} (step {int(state.step)})")
 
@@ -188,10 +263,16 @@ def _train_impl(
         total_steps=config.optim.epochs * steps_per_epoch,
         state_template=state if zero else None,
     )
-    state = place_state(state, mesh, shard_queue_over_model=shard_q, zero=zero)
+    state = place_state(
+        state, mesh, shard_queue_over_model=shard_q, zero=zero, zero_params=zero23
+    )
     root_rng = jax.device_put(
         shuffle_rng, jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
     )
+    # Analytic at-rest state footprint per device (constant for the run:
+    # layout is static) — the ZeRO stages' memory A/B gauge, available
+    # on every backend including CPU meshes where memory_stats is not.
+    hbm_state_bytes = tree_shard_bytes(state)
 
     # Strict tracing (mocolint runtime arm): tracer-leak checking plus a
     # compile-cache-miss counter over the jitted step, read only on log
@@ -298,9 +379,14 @@ def _train_impl(
 
         bank, test = knn_pair
         num_classes = knn_num_classes
+        # ZeRO-2/3: params persist as (n, m) shards — one-shot host
+        # gather back to full shapes for the eval-side forward
+        params_q = state.params_q
+        if zero23:
+            params_q = unshard_tree_host(params_q, step_fn.param_shapes["enc"])
         top1 = knn_eval(
             encoder.backbone,
-            state.params_q["backbone"],
+            params_q["backbone"],
             state.batch_stats_q.get("backbone", {}),
             bank,
             test,
@@ -342,6 +428,19 @@ def _train_impl(
     # ledger is reset here so this run's metrics reflect this run's
     # traced collectives only.
     comms.reset()
+    # ZeRO-2/3: hoist the bucketed params all_gather for step k+1 under
+    # step k — the driver enqueues it right after step k's dispatch
+    # (async; dispatch must stay on THIS thread, see AsyncParamGather's
+    # concurrent-Execute deadlock note) and the worker absorbs
+    # gather-side stalls; the overlap/zero gauge on every metrics line
+    # is the proof. zero_overlap_gather=False keeps the inline schedule.
+    # This initial submit TRACES the gather, so it must come AFTER the
+    # comms.reset() above or the per-bucket ledger sites would be wiped
+    # (tags fire at trace time only).
+    gatherer: Optional[AsyncParamGather] = None
+    if zero23 and config.parallel.zero_overlap_gather:
+        gatherer = AsyncParamGather(step_fn.gather)
+        gatherer.submit(state, int(state.step))
     fleet = FleetAggregator() if config.fleet_metrics else None
     heartbeat = Heartbeat(
         config.workdir, process_index=pidx,
@@ -579,6 +678,11 @@ def _train_impl(
                             )
                         state = guard["good_state"].replace(step=state.step)
                         inflight.clear()  # poisoned-lineage refs: drop them
+                        if gatherer is not None:
+                            # the in-flight gather belongs to the poisoned
+                            # lineage — drop it and gather the rolled-back
+                            # shards instead
+                            gatherer.resubmit(state, gstep)
                         return
                     # p["state"] is the state AS OF this logged step —
                     # `state` itself may already be one dispatch ahead
@@ -610,10 +714,15 @@ def _train_impl(
                         # lacks memory_stats (CPU hosts)
                         **probe.payload(),
                         **memory_payload(),
+                        # at-rest state footprint (analytic, per device)
+                        "hbm_state_bytes": hbm_state_bytes,
                         # input wire (device prefetch ring): last
                         # batch's transfer time/bytes + live staged
                         # depth — absent on the sync path
                         **wire,
+                        # ZeRO-2/3 hoisted-gather overlap efficiency —
+                        # absent without the gather worker
+                        **(gatherer.payload() if gatherer is not None else {}),
                     }
                     # fault-tolerance observability: only present
                     # when nonzero, so clean runs keep clean lines
@@ -699,7 +808,18 @@ def _train_impl(
                         probe.data_wait(t_data)
                         t_disp0 = time.perf_counter()
                         with obs.span("step", step=gstep_host):
-                            state, metrics = step_fn(state, batch, root_rng)
+                            if gatherer is not None:
+                                # the gather for THIS step was issued one
+                                # iteration ago and ran under the previous
+                                # step; take() blocks only for what didn't
+                                # fit under it (the overlap/zero gauge)
+                                gathered = gatherer.take()
+                                state, metrics = step_fn.step(
+                                    state, gathered, batch, root_rng
+                                )
+                                gatherer.submit(state, gstep_host + 1)
+                            else:
+                                state, metrics = step_fn(state, batch, root_rng)
                         probe.dispatched(time.perf_counter() - t_disp0)
                         if probe.should_sample(gstep_host):
                             # drain the device queue ON SAMPLED STEPS ONLY,
@@ -793,6 +913,8 @@ def _train_impl(
                     )
                     break
     finally:
+        if gatherer is not None:
+            gatherer.close()  # join the gather worker; drop a parked result
         if schedule_sanitizer is not None:
             from moco_tpu.analysis.sanitizer import install_recorder
 

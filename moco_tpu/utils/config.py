@@ -166,11 +166,28 @@ class DataConfig:
 class ParallelConfig:
     num_data: Optional[int] = None  # None = all devices
     num_model: int = 1  # shards the queue/logits for very large K
-    # Sharded weight update (ZeRO-1 over the data axis, arXiv:2004.13336
+    # Sharded weight update (ZeRO over the data axis, arXiv:2004.13336
     # — moco_tpu/parallel/zero.py): optimizer state and update sharded
     # 1/n per replica via psum_scatter + all_gather. Element-wise
     # optimizers only (sgd/adamw).
     shard_weight_update: bool = False
+    # ZeRO stage (meaningful with shard_weight_update): 1 = sharded
+    # optimizer state only, params re-gathered inside every step (the
+    # original). 2/3 (both spellings select the same implementation) =
+    # params_q/params_k/predictor ALSO persist between steps as
+    # P(data)-sharded flat shards: ~3/n at-rest model memory, the EMA
+    # key update runs shard-local (no collective), and the per-bucket
+    # params all_gather for step k+1 is hoisted under step k's compute
+    # by the pipelined driver (parallel/zero.py module docstring).
+    zero_stage: int = 1
+    # Fusion-bucket size for the stage-2/3 bucketed collectives: leaves
+    # pack into ~this many MB of SHARD payload per all_gather /
+    # psum_scatter launch (one collective per bucket, not per leaf).
+    zero_bucket_mb: float = 4.0
+    # Hoist the stage-2/3 params gather onto the AsyncParamGather worker
+    # so it overlaps the previous step (default); False runs gather +
+    # step inline (A/B lever; the overlap/zero gauge is then absent).
+    zero_overlap_gather: bool = True
 
 
 @dataclasses.dataclass(frozen=True)
@@ -374,7 +391,13 @@ RESUME_COMPAT_FIELDS = {
         "vit_pool", "vit_patch_size", "vit_sequence_parallel",
     ),
     "data": ("image_size",),
-    "parallel": ("num_model", "shard_weight_update"),
+    # NOTE: parallel.shard_weight_update / zero_stage / num_data are
+    # deliberately NOT hard-compat fields anymore: a layout mismatch is
+    # "compatible but resharded" — the driver restores into a template
+    # of the checkpoint's own layout and converts host-side
+    # (core/moco.py:reshard_state), so zero1 -> zero23, sharded ->
+    # replicated, and mesh-width changes all resume.
+    "parallel": ("num_model",),
 }
 
 
@@ -396,18 +419,10 @@ def resume_compat_diff(saved_extra: dict, config: TrainConfig, num_data: int) ->
                 lv = list(lv)
             if sv != lv:
                 diffs.append(f"{section}.{f}: checkpoint={sv!r} != config={lv!r}")
-    saved_nd = saved_extra.get("num_data")
-    if (
-        saved_nd is not None
-        and config.parallel.shard_weight_update
-        and int(saved_nd) != int(num_data)
-    ):
-        # ZeRO shards opt-state leaves (num_data, m): the mesh width is
-        # baked into the checkpoint's shapes
-        diffs.append(
-            f"num_data: checkpoint={saved_nd} != mesh={num_data} "
-            "(ZeRO opt state is sharded per data replica)"
-        )
+    # num_data under ZeRO used to be a hard incompatibility (the mesh
+    # width is baked into the (n, m) shard shapes); since reshard_state
+    # it is a resharding case, handled by the driver's layout-aware
+    # restore — no diff entry.
     return diffs
 
 

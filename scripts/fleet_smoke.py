@@ -7,6 +7,14 @@ must produce the full fleet surface, asserted hard.
 (The script pins an 8-virtual-device CPU platform itself, so it runs
 identically in CI and on a dev box.)
 
+Since ISSUE 7 the smoke also proves the ZeRO-2/3 surface on the same
+fake-8-device mesh (`run_zero_ab` + `assert_zero_surface`): a zero1 and
+a zero23 driver run in subdirectories, asserting the at-rest
+`hbm_state_bytes` drop, the per-bucket comms sites, identical loss
+trajectories, and — under an injected `delay@site=zero.gather` slow
+collective — `overlap/zero >= 0.5` with the `zero_gather` spans
+visibly overlapping main-thread work in the trace.
+
 Asserts (the ISSUE-4 acceptance bullet, executable):
 
 1. every process-0 training line in `metrics.jsonl` carries the fleet
@@ -101,6 +109,127 @@ def run_smoke(workdir: str) -> dict:
 def _read_jsonl(path):
     with open(path) as f:
         return [json.loads(l) for l in f if l.strip()]
+
+
+ZERO_DELAY_S = 0.05  # synthetic slow-collective: injected gather delay
+
+
+def run_zero_ab(workdir: str) -> dict:
+    """Two tiny ZeRO driver runs — stage 1 vs stage 2/3 — on the same
+    fake-8-device mesh; the zero23 leg runs under a deterministic
+    `delay@site=zero.gather` fault so the hoisted gather has something
+    to hide. Returns {'zero1': subdir, 'zero23': subdir}."""
+    from moco_tpu.data.datasets import SyntheticDataset
+    from moco_tpu.train import train
+    from moco_tpu.utils import faults
+    from moco_tpu.utils.config import (
+        DataConfig,
+        MocoConfig,
+        OptimConfig,
+        ParallelConfig,
+        TrainConfig,
+    )
+
+    out = {}
+    for name, stage, spec in (
+        ("zero1", 1, None),
+        ("zero23", 3, f"delay@site=zero.gather:seconds={ZERO_DELAY_S}"),
+    ):
+        wd = os.path.join(workdir, name)
+        os.makedirs(wd, exist_ok=True)
+        config = TrainConfig(
+            moco=MocoConfig(
+                arch="resnet18", dim=16, num_negatives=128, temperature=0.2,
+                mlp=True, shuffle="none", cifar_stem=True, compute_dtype="float32",
+            ),
+            optim=OptimConfig(lr=0.03, epochs=1, cos=True),
+            data=DataConfig(
+                dataset="synthetic", image_size=16, global_batch=64, num_workers=2
+            ),
+            parallel=ParallelConfig(
+                num_data=8, shard_weight_update=True, zero_stage=stage,
+                # small buckets so the tiny model still packs >1 bucket
+                # (the per-bucket ledger sites need plurality to prove
+                # bucketing, not just one giant concat)
+                zero_bucket_mb=0.002,
+            ),
+            workdir=wd, log_every=1, obs_probe_every=2, fleet_metrics=True,
+        )
+        if spec:
+            faults.install(spec)
+        try:
+            train(config, dataset=SyntheticDataset(num_examples=4 * 64, image_size=16))
+        finally:
+            faults.clear()
+        out[name] = wd
+    return out
+
+
+def assert_zero_surface(dirs: dict) -> None:
+    """The ISSUE-7 acceptance bullet, executable: hbm drop, overlap,
+    per-bucket sites, trace overlap, identical trajectories."""
+    from moco_tpu.obs import schema
+
+    lines = {}
+    for name, wd in dirs.items():
+        recs = schema.read_metrics(os.path.join(wd, "metrics.jsonl"))
+        errors = schema.validate_file(os.path.join(wd, "metrics.jsonl"))
+        assert not errors, f"{name} schema violations: {errors[:5]}"
+        lines[name] = [r for r in recs if "loss" in r and "event" not in r]
+        assert lines[name], f"{name} produced no training lines"
+
+    # -- 1. persistently sharded params measurably shrink at-rest state
+    s1 = lines["zero1"][-1]["hbm_state_bytes"]
+    s23 = lines["zero23"][-1]["hbm_state_bytes"]
+    assert s23 < 0.5 * s1, (
+        f"zero23 at-rest state {s23} not measurably below zero1 {s1}"
+    )
+
+    # -- 2. the hoisted bucketed gather hides the injected slow
+    # collective: overlap/zero >= 0.5 once past the compile steps
+    overlaps = [r.get("overlap/zero") for r in lines["zero23"]]
+    assert all(o is not None for o in overlaps), f"overlap/zero missing: {overlaps}"
+    assert overlaps[-1] >= 0.5, (
+        f"steady-state overlap/zero {overlaps[-1]} < 0.5 under the "
+        f"{ZERO_DELAY_S}s gather delay fault: {overlaps}"
+    )
+
+    # -- 3. per-bucket collective sites in the comms ledger, non-zero
+    last = lines["zero23"][-1]
+    for site in ("comms/zero.gather_q.b0", "comms/zero.gather_k.b0", "comms/zero.scatter.b0"):
+        assert last.get(site, 0) > 0, f"{site} missing or zero: {last.get(site)!r}"
+    n_buckets = len([k for k in last if k.startswith("comms/zero.gather_q.b")])
+    assert n_buckets > 1, f"expected >1 fusion bucket at the tiny bucket size, got {n_buckets}"
+
+    # -- 4. zero23 trajectory identical to zero1 (same seeds, same math)
+    l1 = [round(r["loss"], 6) for r in lines["zero1"]]
+    l23 = [round(r["loss"], 6) for r in lines["zero23"]]
+    assert l1 == l23, f"zero23 diverged from zero1: {l1} vs {l23}"
+
+    # -- 5. the gather visibly overlaps main-thread work in the trace:
+    # some zero_gather span (worker thread) intersects a step/data_wait
+    # span (driver thread) in wall time
+    spans = _read_jsonl(os.path.join(dirs["zero23"], "trace_events.jsonl"))
+    gathers = [s for s in spans if s.get("name") == "zero_gather"]
+    driver = [
+        s for s in spans if s.get("name") in ("step", "data_wait", "device_wait")
+    ]
+    assert gathers, "no zero_gather spans in the trace"
+
+    def _iv(s):
+        return s["ts"], s["ts"] + s.get("dur", 0.0)
+
+    overlapping = any(
+        a0 < b1 and b0 < a1
+        for g in gathers
+        for d in driver
+        if g.get("tid") != d.get("tid")
+        for (a0, a1), (b0, b1) in [(_iv(g), _iv(d))]
+    )
+    assert overlapping, (
+        "no zero_gather span overlaps driver-thread work — the gather "
+        "is not hoisted under compute"
+    )
 
 
 def assert_surface(workdir: str) -> None:
@@ -209,8 +338,11 @@ def main() -> int:
     assert_surface(workdir)
     merged = assert_merged_trace(workdir)
     assert_strict_report(workdir)
+    zero_dirs = run_zero_ab(os.path.join(workdir, "zero_ab"))
+    assert_zero_surface(zero_dirs)
     print(
         f"fleet smoke OK: {out['result']} — merged trace {merged}, "
+        f"ZeRO A/B under {os.path.join(workdir, 'zero_ab')}, "
         f"artifacts in {workdir}"
     )
     return 0

@@ -1,0 +1,181 @@
+#!/usr/bin/env python
+"""Tracked perf ledger + regression gate — the end of benchmark blindness.
+
+BENCH r02–r05 silently degraded to the CPU smoke and nobody could say
+from the JSON alone whether the perf trajectory regressed. PR 6's
+per-leg skip ledger answered "why didn't this leg run"; this module
+answers "is this run slower than the last comparable one" — and makes
+the answer a CI failure instead of an archaeology project.
+
+`PERF_LEDGER.json` (tracked in-repo) holds one entry per bench run:
+headline imgs/s/chip, MFU, overlap_efficiency, obs overhead, hbm peak,
+comms/total share and the ZeRO A/B when bench recorded them, plus the
+per-leg skip ledger verbatim.
+
+    python scripts/perf_ledger.py append --input bench_out.json --run-id r06
+    python scripts/perf_ledger.py check  --input bench_out.json
+    python scripts/perf_ledger.py show
+
+`check` compares the candidate's headline `value` against the MOST
+RECENT ledger entry with the same metric name (same platform + model
+family by construction — CPU-smoke and TPU numbers never cross). No
+comparable entry -> pass, with the reason printed (the gate only bites
+when a comparable platform leg exists). Regression beyond the threshold
+-> exit 1. Thresholds: 10% on accelerator metrics; CPU-smoke metrics
+default to 50% because shared CI runners jitter far beyond 10% — still
+a gate against catastrophic regressions, never a flake source.
+
+Stdlib-only, like obs/schema.py, so CI can run it before heavy deps.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+DEFAULT_LEDGER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "PERF_LEDGER.json")
+ACCEL_THRESHOLD = 0.10
+CPU_SMOKE_THRESHOLD = 0.50
+
+# bench-JSON fields copied into a ledger entry when present
+TRACKED_FIELDS = (
+    "value",
+    "unit",
+    "vs_baseline",
+    "mfu",
+    "with_data_imgs_per_sec_per_chip",
+    "with_data_sync_imgs_per_sec_per_chip",
+    "overlap_efficiency",
+    "obs_overhead_pct",
+    "hbm_peak_bytes",
+    "comms_total_bytes_per_step",
+    "zero_ab",
+    "legs",
+)
+
+
+def default_threshold(metric: str) -> float:
+    return CPU_SMOKE_THRESHOLD if "cpu_smoke" in metric else ACCEL_THRESHOLD
+
+
+def load_ledger(path: str) -> dict:
+    if not os.path.exists(path):
+        return {"schema": 1, "entries": []}
+    with open(path) as f:
+        ledger = json.load(f)
+    if "entries" not in ledger:
+        raise ValueError(f"{path} is not a perf ledger (no 'entries')")
+    return ledger
+
+
+def load_bench_record(path: str) -> dict:
+    """A bench record: either bench.py's one-line JSON itself, or a
+    BENCH_r*.json driver wrapper carrying it under 'parsed'."""
+    with open(path) as f:
+        rec = json.load(f)
+    if "parsed" in rec and isinstance(rec["parsed"], dict):
+        rec = rec["parsed"]
+    if "metric" not in rec or "value" not in rec:
+        raise ValueError(f"{path} carries no metric/value pair")
+    return rec
+
+
+def make_entry(rec: dict, run_id: str, note: str | None = None) -> dict:
+    entry = {"run_id": run_id, "metric": rec["metric"]}
+    entry["platform"] = "cpu" if "cpu_smoke" in rec["metric"] else "tpu"
+    for k in TRACKED_FIELDS:
+        if k in rec:
+            entry[k] = rec[k]
+    if note:
+        entry["note"] = note
+    return entry
+
+
+def append(ledger_path: str, input_path: str, run_id: str, note: str | None = None) -> dict:
+    ledger = load_ledger(ledger_path)
+    entry = make_entry(load_bench_record(input_path), run_id, note)
+    ledger["entries"].append(entry)
+    with open(ledger_path, "w") as f:
+        json.dump(ledger, f, indent=2)
+        f.write("\n")
+    return entry
+
+
+def check(ledger_path: str, input_path: str, threshold: float | None = None) -> int:
+    """0 = pass (or no comparable leg), 1 = regression beyond threshold."""
+    ledger = load_ledger(ledger_path)
+    rec = load_bench_record(input_path)
+    metric, value = rec["metric"], rec.get("value")
+    if value is None:
+        print(f"perf gate: candidate has no value for {metric} — nothing to gate")
+        return 0
+    baseline = None
+    for e in reversed(ledger["entries"]):
+        if e.get("metric") == metric and e.get("value") is not None:
+            baseline = e
+            break
+    if baseline is None:
+        print(
+            f"perf gate: no comparable ledger entry for {metric!r} — pass "
+            "(gate applies only when a comparable platform leg exists)"
+        )
+        return 0
+    thr = default_threshold(metric) if threshold is None else threshold
+    floor = baseline["value"] * (1.0 - thr)
+    delta = (value - baseline["value"]) / baseline["value"] * 100.0
+    verdict = "PASS" if value >= floor else "FAIL"
+    print(
+        f"perf gate [{verdict}] {metric}: {value:.2f} vs {baseline['value']:.2f} "
+        f"(run {baseline.get('run_id')}, {delta:+.1f}%, threshold -{thr * 100:.0f}%)"
+    )
+    # informational deltas for the secondary series (never gating —
+    # they gate the day they prove stable enough)
+    for k in ("mfu", "with_data_imgs_per_sec_per_chip", "overlap_efficiency"):
+        a, b = rec.get(k), baseline.get(k)
+        if a is not None and b is not None and b:
+            print(f"  {k}: {a} vs {b} ({(a - b) / b * 100.0:+.1f}%)")
+    return 0 if verdict == "PASS" else 1
+
+
+def show(ledger_path: str) -> int:
+    ledger = load_ledger(ledger_path)
+    for e in ledger["entries"]:
+        print(
+            f"{e.get('run_id', '?'):>6}  {e.get('platform', '?'):>4}  "
+            f"{e.get('value')}  {e.get('metric')}"
+        )
+    print(f"{len(ledger['entries'])} entries in {os.path.abspath(ledger_path)}")
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p_append = sub.add_parser("append", help="append a bench record to the ledger")
+    p_append.add_argument("--input", required=True, help="bench JSON (raw line or BENCH_r*.json)")
+    p_append.add_argument("--ledger", default=DEFAULT_LEDGER)
+    p_append.add_argument("--run-id", required=True, help="e.g. r06")
+    p_append.add_argument("--note", default=None)
+    p_check = sub.add_parser("check", help="gate a candidate against the last comparable entry")
+    p_check.add_argument("--input", required=True)
+    p_check.add_argument("--ledger", default=DEFAULT_LEDGER)
+    p_check.add_argument(
+        "--threshold", type=float, default=None,
+        help="fractional regression allowed (default 0.10; 0.50 for cpu_smoke metrics)",
+    )
+    p_show = sub.add_parser("show", help="list ledger entries")
+    p_show.add_argument("--ledger", default=DEFAULT_LEDGER)
+    args = ap.parse_args()
+    if args.cmd == "append":
+        entry = append(args.ledger, args.input, args.run_id, args.note)
+        print(f"appended {entry['run_id']} ({entry['metric']}={entry.get('value')})")
+        return 0
+    if args.cmd == "check":
+        return check(args.ledger, args.input, args.threshold)
+    return show(args.ledger)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

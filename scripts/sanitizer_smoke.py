@@ -18,6 +18,11 @@ through `obs/comms.py` tags on an 8-virtual-device mesh):
    differ, `check()` must raise `ScheduleDivergenceError`, the message
    must carry a PER-SITE diff naming `shuffle.a2a`, and
    `schedule_diff.json` must land on disk (the CI artifact).
+3. **zero23** — the ZeRO-2/3 bucketed collective schedule
+   (parallel/zero.py `BucketPlan`: per-bucket `zero.gather_q.b<i>` /
+   `zero.scatter.b<i>` sites): two clean processes agree on the
+   bucketed schedule, and an injected `diverge@site=zero.gather_q.b0`
+   is caught with the bucket named in the per-site diff.
 
 The smoke exits nonzero if the detector misses the divergence OR
 false-positives on the clean leg.
@@ -96,6 +101,56 @@ def trace_schedule(process_index: int) -> "ScheduleRecorder":
     return recorder
 
 
+ZERO_DIVERGE_SITE = "zero.gather_q.b0"
+
+
+def trace_zero_schedule(process_index: int) -> "ScheduleRecorder":
+    """Trace the ZeRO-2/3 bucketed collective schedule into a fresh
+    recorder simulating one process: a BucketPlan gather + scatter over
+    a toy two-leaf tree (small bucket size forces >1 bucket) on the
+    8-device mesh, every bucket comms-tagged."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from moco_tpu.analysis.sanitizer import ScheduleRecorder, install_recorder
+    from moco_tpu.parallel.compat import shard_map
+    from moco_tpu.parallel.zero import BucketPlan, shard_tree
+
+    recorder = ScheduleRecorder(process_index=process_index)
+    prev = install_recorder(recorder)
+    try:
+        devices = jax.devices()
+        mesh = Mesh(np.array(devices), ("data",))
+        n = len(devices)
+        tree = {
+            "a": jnp.arange(4096, dtype=jnp.float32).reshape(64, 64),
+            "b": jnp.arange(100, dtype=jnp.float32),
+        }
+        plan = BucketPlan(jax.tree.leaves(tree), n, bucket_bytes=1024)
+        sharded = shard_tree(tree, n)
+
+        def fn(sh):
+            local = jax.tree.map(lambda x: x[0], sh)
+            leaves, treedef = jax.tree.flatten(local)
+            full = jax.tree.unflatten(
+                treedef, plan.gather(leaves, site="zero.gather_q")
+            )
+            grads_sh = plan.scatter_mean(jax.tree.leaves(full), site="zero.scatter")
+            return sum(jnp.sum(g) for g in grads_sh)
+
+        mapped = shard_map(
+            fn, mesh=mesh,
+            in_specs=(jax.tree.map(lambda _: P("data", None), sharded),),
+            out_specs=P(), check_vma=False,
+        )
+        jax.block_until_ready(jax.jit(mapped)(sharded))
+    finally:
+        install_recorder(prev)
+    return recorder
+
+
 def run_smoke(workdir: str) -> dict:
     from moco_tpu.analysis.sanitizer import (
         ScheduleDivergenceError,
@@ -162,6 +217,49 @@ def run_smoke(workdir: str) -> dict:
     print(f"chaos: divergence at {DIVERGE_SITE!r} caught with per-site diff:")
     for line in diff["diff"]:
         print(f"  {line}")
+
+    # ---- leg 3: ZeRO-2/3 bucketed collective schedule ----------------
+    faults.clear()
+    zdir = os.path.join(workdir, "zero23")
+    os.makedirs(zdir, exist_ok=True)
+    z0 = trace_zero_schedule(0)
+    z1 = trace_zero_schedule(1)
+    zsites = [e[0] for e in z0.entries()]
+    gather_sites = [s for s in zsites if s.startswith("zero.gather_q.b")]
+    assert len(gather_sites) > 1, (
+        f"bucketed schedule should carry >1 gather bucket site, got {zsites}"
+    )
+    assert ZERO_DIVERGE_SITE in zsites, f"{ZERO_DIVERGE_SITE!r} not in {zsites}"
+    assert z0.schedule_hash() == z1.schedule_hash(), (
+        "clean zero23 re-trace hashed differently"
+    )
+    szan0 = ScheduleSanitizer(zdir, process_index=0, num_processes=2, recorder=z0)
+    szan1 = ScheduleSanitizer(zdir, process_index=1, num_processes=2, recorder=z1)
+    szan1.publish(step=0)
+    szan0.check(step=0)  # must NOT raise on the bucketed schedule
+    szan1.check(step=0)
+    faults.install(f"diverge@site={ZERO_DIVERGE_SITE}")
+    try:
+        z1_div = trace_zero_schedule(1)
+    finally:
+        faults.clear()
+    szan1_div = ScheduleSanitizer(
+        zdir, process_index=1, num_processes=2, recorder=z1_div
+    )
+    caught = None
+    try:
+        szan1_div.check(step=1)
+    except ScheduleDivergenceError as e:
+        caught = str(e)
+    assert caught is not None, "sanitizer MISSED the bucketed-gather divergence"
+    assert ZERO_DIVERGE_SITE in caught, (
+        f"divergence message lacks the bucket site {ZERO_DIVERGE_SITE!r}:\n{caught}"
+    )
+    report["zero23"] = {"sites": zsites, "caught": True}
+    print(
+        f"zero23: bucketed schedule agrees ({len(zsites)} sites); "
+        f"diverge at {ZERO_DIVERGE_SITE!r} caught"
+    )
     return report
 
 

@@ -227,13 +227,22 @@ def test_resume_compat_diff_fields():
         cfg, optim=dataclasses.replace(cfg.optim, lr=9.9, epochs=500)
     )
     assert resume_compat_diff(saved, cfg3, 8) == []
-    # ZeRO mesh-width mismatch only matters when sharding the update
+    # ZeRO layout fields (shard_weight_update / zero_stage / mesh
+    # width) are "compatible but resharded" since ISSUE 7 — the driver
+    # restores into the checkpoint's own layout and converts
+    # (core/moco.py:reshard_state), so they produce NO hard diff
     zcfg = dataclasses.replace(
         cfg, parallel=dataclasses.replace(cfg.parallel, shard_weight_update=True)
     )
     zsaved = {"config": config_to_dict(zcfg), "num_data": 8}
-    assert any("num_data" in s for s in resume_compat_diff(zsaved, zcfg, 4))
+    assert resume_compat_diff(zsaved, zcfg, 4) == []  # resharded, not rejected
+    assert resume_compat_diff(zsaved, cfg, 8) == []  # sharded -> replicated: free
     assert resume_compat_diff(saved, cfg, 4) == []  # non-ZeRO: free
+    # ...but num_model stays structural (queue sharding changes shapes)
+    mcfg = dataclasses.replace(
+        cfg, parallel=dataclasses.replace(cfg.parallel, num_model=2)
+    )
+    assert any("num_model" in s for s in resume_compat_diff(saved, mcfg, 8))
     # pre-layer checkpoints (no config recorded) stay resumable
     assert resume_compat_diff({"epoch": 3}, cfg2, 8) == []
 

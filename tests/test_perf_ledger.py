@@ -1,0 +1,94 @@
+"""Perf ledger + regression gate (scripts/perf_ledger.py): append
+builds entries from bench JSON (raw line or BENCH_r*.json wrapper),
+check gates on the last comparable metric with platform-aware
+thresholds, and the tracked PERF_LEDGER.json seed stays loadable."""
+
+import json
+import os
+
+import pytest
+
+from tests.conftest import load_script
+
+ledger_mod = load_script("perf_ledger.py")
+
+
+def _write(path, obj):
+    with open(path, "w") as f:
+        json.dump(obj, f)
+    return str(path)
+
+
+BENCH_TPU = {
+    "metric": "moco_v2_r50_pretrain_imgs_per_sec_per_chip",
+    "value": 2000.0,
+    "unit": "imgs/sec/chip",
+    "mfu": 0.31,
+    "overlap_efficiency": 0.95,
+    "legs": {"accelerator": {"ran": True, "skip_reason": None}},
+}
+
+
+def test_append_and_check_pass(tmp_path):
+    ledger = str(tmp_path / "ledger.json")
+    bench = _write(tmp_path / "b1.json", BENCH_TPU)
+    entry = ledger_mod.append(ledger, bench, "r10", note="unit")
+    assert entry["platform"] == "tpu" and entry["value"] == 2000.0
+    # within 10%: pass
+    cand = _write(tmp_path / "b2.json", {**BENCH_TPU, "value": 1850.0})
+    assert ledger_mod.check(ledger, cand) == 0
+
+
+def test_check_fails_on_regression(tmp_path):
+    ledger = str(tmp_path / "ledger.json")
+    ledger_mod.append(ledger, _write(tmp_path / "b1.json", BENCH_TPU), "r10")
+    cand = _write(tmp_path / "b2.json", {**BENCH_TPU, "value": 1700.0})  # -15%
+    assert ledger_mod.check(ledger, cand) == 1
+    # explicit looser threshold overrides the default
+    assert ledger_mod.check(ledger, cand, threshold=0.2) == 0
+
+
+def test_check_cpu_smoke_uses_wide_threshold(tmp_path):
+    ledger = str(tmp_path / "ledger.json")
+    cpu = {"metric": "moco_v1_r18_cpu_smoke_imgs_per_sec", "value": 10.0}
+    ledger_mod.append(ledger, _write(tmp_path / "c1.json", cpu), "r10")
+    # -40% on a shared CI runner: inside the 50% CPU noise floor
+    assert ledger_mod.check(ledger, _write(tmp_path / "c2.json", {**cpu, "value": 6.0})) == 0
+    # -60%: catastrophic, still gated
+    assert ledger_mod.check(ledger, _write(tmp_path / "c3.json", {**cpu, "value": 3.9})) == 1
+
+
+def test_check_without_comparable_entry_passes(tmp_path):
+    ledger = str(tmp_path / "ledger.json")
+    ledger_mod.append(ledger, _write(tmp_path / "b1.json", BENCH_TPU), "r10")
+    other = {"metric": "moco_v3_vit_b16_pretrain_imgs_per_sec_per_chip", "value": 1.0}
+    assert ledger_mod.check(ledger, _write(tmp_path / "o.json", other)) == 0
+    # an empty/missing ledger also passes (gate needs a comparable leg)
+    assert ledger_mod.check(str(tmp_path / "none.json"), _write(tmp_path / "o2.json", other)) == 0
+
+
+def test_append_reads_bench_wrapper_format(tmp_path):
+    ledger = str(tmp_path / "ledger.json")
+    wrapper = {"n": 1, "rc": 0, "parsed": {**BENCH_TPU, "value": 1234.0}}
+    entry = ledger_mod.append(ledger, _write(tmp_path / "w.json", wrapper), "r11")
+    assert entry["value"] == 1234.0
+    data = json.load(open(ledger))
+    assert data["entries"][-1]["run_id"] == "r11"
+
+
+def test_tracked_seed_ledger_is_valid():
+    path = os.path.join(os.path.dirname(__file__), "..", "PERF_LEDGER.json")
+    ledger = ledger_mod.load_ledger(path)
+    assert len(ledger["entries"]) >= 5
+    metrics = {e["metric"] for e in ledger["entries"]}
+    assert "moco_v2_r50_pretrain_imgs_per_sec_per_chip" in metrics
+    # every entry carries the fields the gate needs
+    for e in ledger["entries"]:
+        assert "run_id" in e and "metric" in e and "platform" in e
+
+
+def test_value_none_is_not_gated(tmp_path):
+    ledger = str(tmp_path / "ledger.json")
+    ledger_mod.append(ledger, _write(tmp_path / "b1.json", BENCH_TPU), "r10")
+    cand = {"metric": BENCH_TPU["metric"], "value": None}
+    assert ledger_mod.check(ledger, _write(tmp_path / "n.json", cand)) == 0

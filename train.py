@@ -182,7 +182,23 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num-model", type=int, default=None, help="model-axis size (shards the queue)")
     p.add_argument(
         "--shard-weight-update", action="store_true", default=None,
-        help="ZeRO-1: shard optimizer state + weight update over the data axis (sgd/adamw)",
+        help="ZeRO: shard optimizer state + weight update over the data axis (sgd/adamw)",
+    )
+    p.add_argument(
+        "--zero-stage", type=int, default=None, choices=(1, 2, 3),
+        help="with --shard-weight-update: 1 = sharded opt state only; "
+        "2/3 = params also persist as P(data) shards with bucketed, "
+        "driver-overlapped collectives (parallel/zero.py)",
+    )
+    p.add_argument(
+        "--zero-bucket-mb", type=float, default=None,
+        help="ZeRO-2/3 fusion-bucket size (MB of shard payload per collective)",
+    )
+    p.add_argument(
+        "--no-zero-overlap-gather", dest="zero_overlap_gather",
+        action="store_false", default=None,
+        help="run the ZeRO-2/3 params gather inline instead of hoisted "
+        "under the previous step (A/B lever)",
     )
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--workdir", default=None)
@@ -322,6 +338,9 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         num_data=args.num_data,
         num_model=args.num_model,
         shard_weight_update=args.shard_weight_update,
+        zero_stage=args.zero_stage,
+        zero_bucket_mb=args.zero_bucket_mb,
+        zero_overlap_gather=args.zero_overlap_gather,
     )
     return override(
         dataclasses.replace(cfg, moco=moco, optim=optim, data=data, parallel=parallel),
