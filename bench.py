@@ -122,6 +122,7 @@ def main() -> None:
             "obs_overhead",
             "with_data",
             "zero_ab",
+            "serving",
         )
     }
 
@@ -471,6 +472,155 @@ def main() -> None:
         except Exception as e:
             _skip("zero_ab", f"leg crashed: {e!r:.200}")
 
+    # ---- serving leg (queries/s/chip at a fixed SLO) ------------------
+    # The platform-independent second headline (ISSUE 8): the key (EMA)
+    # encoder behind the continuous batcher, closed-loop clients firing
+    # mixed-size requests, measured queries/s at a fixed latency SLO
+    # plus padded-bucket occupancy. Runs on the CPU fallback too — the
+    # perf trajectory keeps a serving series even when the TPU tunnel
+    # is down (the BENCH r02–r05 lesson, applied to the new subsystem).
+    serving = None
+    if os.environ.get("BENCH_SKIP_SERVE"):
+        _skip("serving", "BENCH_SKIP_SERVE set")
+    else:
+        try:
+            import threading
+
+            from moco_tpu.serve.batcher import ContinuousBatcher
+            from moco_tpu.serve.engine import InferenceEngine
+            from moco_tpu.serve.index import EmbeddingIndex
+
+            # CPU smoke: shrink the bucket ladder and widen the SLO —
+            # the point off-TPU is a nonzero tracked series, not an
+            # achievable latency target (same degradation philosophy as
+            # the headline's resnet18/32px fallback)
+            slo_ms = float(
+                os.environ.get("BENCH_SERVE_SLO_MS", 25.0 if on_tpu else 2000.0)
+            )
+            # the FULL key encoder (backbone + head): serving embeds in
+            # the dictionary's space, so the step's own queue rows are
+            # the /neighbors corpus
+            eng = InferenceEngine(
+                encoder,
+                jax.device_get(state.params_k),
+                jax.device_get(state.batch_stats_k),
+                image_size=img,
+                buckets=(1, 8, 32, 128) if on_tpu else (1, 8, 32),
+            )
+            eng.warmup()
+            index = None
+            if moco.num_negatives > 0:
+                index = EmbeddingIndex.from_train_queue(jax.device_get(state.queue))
+                index.prepare(eng.buckets, k=5)
+                index.freeze()
+
+            def run_batch(images, want_neighbors):
+                if want_neighbors and index is not None:
+                    emb, scores, nidx, executed = eng.embed_and_query(images, index, 5)
+                    return {"embedding": emb, "scores": scores, "indices": nidx}, executed
+                emb, executed = eng.embed(images)
+                return {"embedding": emb}, executed
+
+            batcher = ContinuousBatcher(
+                run_batch, max_batch=eng.buckets[-1], slo_ms=slo_ms
+            )
+            sizes = tuple(
+                s for s in (1, 2, 4, 8, 16, 32) if s <= eng.buckets[-1]
+            )
+            canned = {
+                n: np.random.default_rng(n).integers(0, 255, (n, img, img, 3), np.uint8)
+                for n in sizes
+            }
+            measuring = threading.Event()
+            stop_clients = threading.Event()
+            counts = [0] * 8
+
+            def client(ci: int) -> None:
+                crng = np.random.default_rng(100 + ci)
+                while not stop_clients.is_set():
+                    n = int(crng.choice(sizes))
+                    try:
+                        fut = batcher.submit(
+                            canned[n], want_neighbors=index is not None
+                        )
+                        fut.result(timeout=30.0)
+                    except Exception:
+                        return
+                    if measuring.is_set():
+                        counts[ci] += 1
+
+            clients = [
+                threading.Thread(target=client, args=(i,), daemon=True)
+                for i in range(len(counts))
+            ]
+            for c in clients:
+                c.start()
+            warm_s = float(os.environ.get("BENCH_SERVE_WARM_S", 1.0 if on_tpu else 3.0))
+            measure_s = float(
+                os.environ.get("BENCH_SERVE_MEASURE_S", 3.0 if on_tpu else 8.0)
+            )
+            time.sleep(warm_s)
+            measuring.set()
+            t0s = time.perf_counter()
+            time.sleep(measure_s)
+            measuring.clear()
+            dts = time.perf_counter() - t0s
+            stop_clients.set()
+            batcher.close()
+            for c in clients:
+                c.join(timeout=5.0)
+            payload = batcher.metrics.payload()
+            completed = sum(counts)
+            if completed == 0:
+                raise RuntimeError(
+                    f"no request completed inside the {measure_s}s measure "
+                    "window — raise BENCH_SERVE_MEASURE_S on very slow hosts"
+                )
+            qps_chip = completed / dts / n_dev
+            recompiles = eng.recompiles_after_warmup + (
+                index.recompiles_after_warmup if index is not None else 0
+            )
+            if recompiles:
+                raise RuntimeError(
+                    f"serving leg recompiled {recompiles}x after warmup"
+                )
+            serving = {
+                "metric": (
+                    f"moco_serve_{arch}_queries_per_sec_per_chip"
+                    if on_tpu
+                    else f"moco_serve_{arch}_cpu_smoke_queries_per_sec"
+                ),
+                "value": round(qps_chip, 2),
+                "unit": "queries/sec/chip",
+                "slo_ms": slo_ms,
+                "p50_ms": round(payload["serve/p50_ms"], 2),
+                "p99_ms": round(payload["serve/p99_ms"], 2),
+                "occupancy": round(payload["serve/occupancy"], 4),
+                "slo_violation_rate": (
+                    round(payload["serve/slo_violations"] / payload["serve/requests"], 4)
+                    if payload["serve/requests"]
+                    else None
+                ),
+                "bucket_histogram": {
+                    k.split("_", 1)[1]: v
+                    for k, v in payload.items()
+                    if k.startswith("serve/bucket_")
+                },
+                "neighbors": index is not None,
+            }
+            legs["serving"]["ran"] = True
+            print(
+                f"serving: {qps_chip:.1f} queries/s/chip @ SLO {slo_ms}ms "
+                f"(p50={payload['serve/p50_ms']}ms p99={payload['serve/p99_ms']}ms "
+                f"occupancy={payload['serve/occupancy']} "
+                f"violations={serving['slo_violation_rate']})",
+                file=sys.stderr,
+            )
+        except Exception as e:
+            serving = None  # never ship a half-built serving record
+            legs["serving"]["ran"] = False
+            _skip("serving", f"leg crashed: {e!r:.200}")
+
     # ---- MFU (per-device FLOPs over per-device peak) ------------------
     flops_per_dev = _step_flops(step, state, batch_dict, root_rng) or (
         None if is_vit else _analytic_step_flops(batch, img) / n_dev
@@ -641,6 +791,11 @@ def main() -> None:
                 # rate, device hbm peak, analytic at-rest state bytes,
                 # and bucketed-collective bytes/step
                 "zero_ab": zero_ab,
+                # serving leg (ISSUE 8): the second headline series —
+                # queries/s/chip through the continuous batcher at a
+                # fixed SLO, with its own metric name so the perf
+                # ledger gates it independently of the training rate
+                "serving": serving,
                 # per-leg skip ledger: WHY a leg didn't run, in-band —
                 # a BENCH_*.json degraded to the CPU smoke now says so
                 # itself (accelerator.skip_reason) instead of relying on

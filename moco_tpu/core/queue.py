@@ -10,6 +10,13 @@ the train state (replicated sharding), updated with
 `lax.dynamic_update_slice` *inside* the jitted step — no host round-trip,
 no mutable buffer. Because `K % global_batch == 0` the write never wraps,
 so a single dynamic slice suffices (same invariant as the reference).
+
+Since the serving subsystem landed, the queue is the train-time instance
+of the embedding index: the FIFO write itself lives in
+`moco_tpu/serve/index.py` (`fifo_write`, bit-identical to the
+pre-refactor body here — pinned by tests/test_serve.py), so training and
+the `/neighbors` serving path maintain their dictionaries with one
+kernel. This module keeps the training-facing API and invariants.
 """
 
 from __future__ import annotations
@@ -18,6 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from moco_tpu.ops.losses import l2_normalize
+from moco_tpu.serve.index import fifo_write
 
 
 def init_queue(rng: jax.Array, num_negatives: int, dim: int) -> jax.Array:
@@ -31,13 +39,11 @@ def enqueue(queue: jax.Array, ptr: jax.Array, keys: jax.Array) -> tuple[jax.Arra
 
     Requires K % N == 0 (checked statically by the caller /
     `check_queue_divisibility`), mirroring the reference's
-    `assert self.K % batch_size == 0`.
+    `assert self.K % batch_size == 0`. Delegates to the shared index
+    kernel (`serve/index.py:fifo_write`) — the refactor is bitwise
+    invisible to the loss trajectory.
     """
-    num_neg = queue.shape[0]
-    keys = jax.lax.stop_gradient(keys).astype(queue.dtype)
-    queue = jax.lax.dynamic_update_slice(queue, keys, (ptr, jnp.zeros_like(ptr)))
-    new_ptr = (ptr + keys.shape[0]) % num_neg
-    return queue, new_ptr
+    return fifo_write(queue, ptr, keys)
 
 
 def check_queue_divisibility(num_negatives: int, global_batch: int) -> None:

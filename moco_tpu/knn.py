@@ -10,6 +10,12 @@ rebuild an early-warning metric the reference lacks.
 
 Classifier: cosine-similarity kNN with temperature-weighted voting —
     score(class c) = Σ_{i ∈ topk} 1[y_i = c] · exp(sim_i / T)
+
+The cosine top-k scan itself is the serving subsystem's shared kernel
+(`serve/index.py:topk_cosine`) — the same scan `/neighbors` answers
+with, so a serving-side kernel regression is caught by the kNN tests
+and vice versa (tests/test_serve.py pins bitwise equivalence against
+the pre-refactor inline scan).
 """
 
 from __future__ import annotations
@@ -100,10 +106,11 @@ def knn_classify(
     bank = jnp.asarray(train_feats)
     bank_labels = jnp.asarray(train_labels)
 
+    from moco_tpu.serve.index import topk_cosine
+
     @jax.jit
     def classify(q):
-        sims = q @ bank.T  # (m, N) cosine (inputs are normalized)
-        top_sims, top_idx = jax.lax.top_k(sims, k)
+        top_sims, top_idx = topk_cosine(q, bank, k)  # (m, k) cosine scan
         weights = jnp.exp(top_sims / temperature)  # (m, k)
         votes = jax.nn.one_hot(bank_labels[top_idx], num_classes)  # (m, k, C)
         scores = jnp.einsum("mk,mkc->mc", weights, votes)

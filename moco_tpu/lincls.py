@@ -65,18 +65,26 @@ class ProbeState(struct.PyTreeNode):
     opt_state: Any
 
 
-def load_pretrained_backbone(
-    workdir: str, config: Optional[TrainConfig] = None
-) -> tuple[Any, Any, TrainConfig]:
-    """Checkpoint surgery: restore the pretraining state and keep
-    `params_q.backbone` + `batch_stats_q.backbone` — the functional
-    equivalent of keeping `module.encoder_q.*` minus the head.
+def restore_pretrain_state(
+    workdir: str,
+    config: Optional[TrainConfig] = None,
+    unshard: tuple = ("q",),
+) -> tuple[MocoState, TrainConfig]:
+    """Restore the full pretraining MocoState + its resolved config —
+    the shared eval-side entry the probe surgery, the converters, and
+    the serve engine all build on.
 
     With `config=None` the training config stored in the checkpoint's
     extras is used, so the exact model/optimizer template (arch, v3
     predictor, sgd/lars/adamw opt_state tree) is rebuilt without the
-    caller re-specifying flags. Returns (backbone_params, backbone_stats,
-    config)."""
+    caller re-specifying flags.
+
+    `unshard`: which encoder sides ("q"/"k") to gather back to true
+    shapes when the checkpoint persists ZeRO-2/3 (n, m) flat shards
+    (full_param_shapes supplies the shapes; the sharded layout doesn't
+    record them). Only the requested sides pay the one-shot host gather
+    — this is the eval-side unshard every downstream tool
+    (convert_pretrain, eval_lincls, export, serve) inherits."""
     from moco_tpu.core.moco import build_predictor
     from moco_tpu.utils.config import config_from_dict
     from moco_tpu.utils.schedules import build_optimizer
@@ -118,23 +126,44 @@ def load_pretrained_backbone(
     )
     state, _ = mgr.restore(template)
     mgr.close()
-    params_q = state.params_q
     if config.parallel.shard_weight_update and config.parallel.zero_stage >= 2:
-        # ZeRO-2/3: the checkpoint's params persist as (n, m) flat
-        # shards — one-shot host gather back to the true shapes before
-        # the surgery (full_param_shapes supplies them; the sharded
-        # layout doesn't record leaf shapes). This is the eval-side
-        # unshard every downstream tool (convert_pretrain, eval_lincls,
-        # export) inherits through this loader.
+        # ZeRO-2/3: one-shot host gather of the requested sides back to
+        # the true shapes (both encoders persist in the same (n, m)
+        # layout, so one path covers both)
         from moco_tpu.core.moco import full_param_shapes
         from moco_tpu.parallel.zero import unshard_tree_host
 
         shapes = full_param_shapes(config, encoder, predictor)
-        params_q = unshard_tree_host(params_q, shapes["enc"])
-    missing = {k for k in ("backbone", "head") if k not in params_q}
+        replaced = {}
+        if "q" in unshard:
+            replaced["params_q"] = unshard_tree_host(state.params_q, shapes["enc"])
+        if "k" in unshard:
+            replaced["params_k"] = unshard_tree_host(state.params_k, shapes["enc"])
+        state = state.replace(**replaced)
+    return state, config
+
+
+def load_pretrained_backbone(
+    workdir: str, config: Optional[TrainConfig] = None, side: str = "q"
+) -> tuple[Any, Any, TrainConfig]:
+    """Checkpoint surgery: restore the pretraining state and keep
+    `params_<side>.backbone` + `batch_stats_<side>.backbone` — the
+    functional equivalent of keeping `module.encoder_q.*` minus the head.
+
+    `side` selects the encoder: "q" (query — the probe/export default,
+    matching the reference's `module.encoder_q.*` surgery) or "k" (the
+    EMA key encoder — the serving default: the slow-moving stable
+    representation, per "How to Scale Your EMA" arXiv:2307.13813).
+    Returns (backbone_params, backbone_stats, config)."""
+    if side not in ("q", "k"):
+        raise ValueError(f"side must be 'q' or 'k', got {side!r}")
+    state, config = restore_pretrain_state(workdir, config, unshard=(side,))
+    params = state.params_q if side == "q" else state.params_k
+    stats = state.batch_stats_q if side == "q" else state.batch_stats_k
+    missing = {k for k in ("backbone", "head") if k not in params}
     if missing:
-        raise KeyError(f"pretrained params_q missing {missing}")
-    return params_q["backbone"], state.batch_stats_q.get("backbone", {}), config
+        raise KeyError(f"pretrained params_{side} missing {missing}")
+    return params["backbone"], stats.get("backbone", {}), config
 
 
 def _build_probe_model(config: TrainConfig, num_classes: int):

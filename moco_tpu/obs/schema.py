@@ -141,13 +141,18 @@ FIELD_VALIDATORS = {
 
 # key-prefix families sharing one validator: per-layer-group EMA drift,
 # the fleet min/mean/max/argmax gauges (null where no host reports the
-# field), comms bytes counters (analytic, always numeric), and the
-# per-rule Prometheus alert gauges
+# field), comms bytes counters (analytic, always numeric), the per-rule
+# Prometheus alert gauges, and the serving metric family
+# (serve/server.py flushes ServeMetrics.payload() through the sinks:
+# p50_ms/p99_ms null before the first completed request, occupancy null
+# before the first flush, the rest numeric — qps, requests,
+# slo_violations, slo_ms, bucket_<b> histogram counts)
 PREFIX_VALIDATORS = {
     "ema_drift/": _num_or_null,
     "fleet/": _num_or_null,
     "comms/": _num,
     "alert/": _num,
+    "serve/": _num_or_null,
 }
 
 

@@ -363,6 +363,33 @@ def derive_metrics_port(base_port: int, process_index: int) -> int:
     return base_port + process_index if base_port else 0
 
 
+# How far the serve endpoint shifts off a colliding Prometheus port.
+# 16 is an upper bound on co-hosted processes per host, so the shifted
+# serve family can never land on ANY peer process's metrics port.
+SERVE_PORT_STRIDE = 16
+
+
+def resolve_serve_port(serve_port: int, metrics_port: int = 0, process_index: int = 0) -> int:
+    """Per-process serving port with the metrics-collision footgun
+    removed. The offset rule:
+
+    - Prometheus owns `metrics_port + process_index` (derive_metrics_port);
+    - the serve endpoint claims `serve_port + process_index`;
+    - if the two families collide (one process running both the server
+      and `--metrics-port` — previously an EADDRINUSE at bind time,
+      or worse, whichever bound first silently shadowing the other),
+      the serve port shifts up by SERVE_PORT_STRIDE.
+
+    Pick bases ≥ SERVE_PORT_STRIDE apart to avoid the shift entirely;
+    `serve_port=0` stays 0 (ephemeral bind, tests)."""
+    if not serve_port:
+        return 0
+    resolved = serve_port + process_index
+    if metrics_port and resolved == derive_metrics_port(metrics_port, process_index):
+        resolved += SERVE_PORT_STRIDE
+    return resolved
+
+
 def build_sinks(
     spec: str,
     workdir: str,

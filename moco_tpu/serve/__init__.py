@@ -1,0 +1,50 @@
+"""moco_tpu.serve — the embedding inference service.
+
+The "millions of users" leg of the north star: after training the MoCo
+dictionary at scale, this package serves it. Four parts, one request
+path (see each module's docstring):
+
+- `index`   the dictionary as a reusable store: shared FIFO-write +
+            top-k-cosine kernels (core/queue.py and knn.py rehost on
+            them) and the P(data)-shardable `EmbeddingIndex` with
+            AOT-bucketed exact top-k query
+- `engine`  AOT-compiled (`jit().lower().compile()`) bf16 encoder
+            inference, one executable per padded batch bucket
+            {1, 8, 32, 128}, donation-audited, key (EMA) encoder by
+            default — the stable representation per arXiv:2307.13813
+- `batcher` continuous batching: micro-batch coalescing under a latency
+            SLO (flush at max_batch or slo_ms/2), pad to the next
+            bucket, scatter per-request; p50/p99/qps/occupancy metrics
+- `server`  stdlib HTTP endpoint (`/embed`, `/neighbors`, `/stats`,
+            `/healthz`) feeding the `serve/*` metric family into the
+            obs sinks (JSONL schema + Prometheus gauges)
+
+Everything resolves lazily so `import moco_tpu.serve` stays cheap and
+jax-free until a component is actually built.
+"""
+
+_LAZY = {
+    "EmbeddingIndex": "index",
+    "IndexRecompileError": "index",
+    "fifo_write": "index",
+    "topk_cosine": "index",
+    "InferenceEngine": "engine",
+    "EngineRecompileError": "engine",
+    "load_serving_encoder": "engine",
+    "ContinuousBatcher": "batcher",
+    "BatcherClosedError": "batcher",
+    "ServeMetrics": "batcher",
+    "ServeServer": "server",
+    "resolve_serve_port": "server",
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        return getattr(importlib.import_module(f"moco_tpu.serve.{_LAZY[name]}"), name)
+    raise AttributeError(f"module 'moco_tpu.serve' has no attribute {name!r}")
+
+
+__all__ = sorted(_LAZY)

@@ -1,0 +1,281 @@
+"""Continuous batching under a latency SLO.
+
+The device is efficient on the engine's padded buckets; users arrive
+one-at-a-time. The batcher is the adapter: requests enqueue from any
+number of client threads, a single batcher thread coalesces them into
+micro-batches — flushing when the pending rows reach `max_batch` (the
+engine's largest bucket) **or** when the oldest pending request has
+waited `slo_ms / 2` (half the budget queued, half for compute; the
+classic continuous-batching deadline split) — runs the engine call on
+its own thread (the wire/compute never touches a client thread, the
+same discipline as the device prefetch ring), and scatters result rows
+back to each request's future.
+
+Thread hygiene is the JX011 contract (`data/pipeline.py` /
+`device_prefetch.py` lineage): the submit queue is bounded, every
+blocking put polls a stop flag (`_responsive_put`), `close()` drains
+the queue, fails all pending futures with :class:`BatcherClosedError`
+(so put-blocked producers and result-blocked clients both unblock), and
+joins the batcher thread.
+
+Metrics (`ServeMetrics`): per-request latency reservoir → p50/p99,
+completed-request QPS, batch occupancy (valid rows / padded bucket
+rows — the padding tax), a per-bucket execution histogram, and SLO
+violation counts. `payload()` emits the `serve/*` metric family the
+obs schema validates and the Prometheus sink exposes as gauges.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+import numpy as np
+
+
+class BatcherClosedError(RuntimeError):
+    """The batcher shut down before (or while) handling this request."""
+
+
+def _responsive_put(q: queue.Queue, stop: threading.Event, item) -> bool:
+    """Bounded put that stays responsive to a stop flag; False = stopped
+    (the JX011-idiomatic put — see data/device_prefetch.py)."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=0.1)
+            return True
+        except queue.Full:
+            continue
+    return False
+
+
+class ServeFuture:
+    """Single-assignment result handle: `result(timeout)` blocks until
+    the batcher scatters this request's rows back (or fails it)."""
+
+    def __init__(self, num_rows: int, submitted_at: float, want_neighbors: bool):
+        self.num_rows = num_rows
+        self.submitted_at = submitted_at
+        self.want_neighbors = want_neighbors
+        self._done = threading.Event()
+        self._value: Optional[dict] = None
+        self._error: Optional[BaseException] = None
+        self.latency_s: Optional[float] = None
+
+    def _resolve(self, value: dict) -> None:
+        self.latency_s = time.perf_counter() - self.submitted_at
+        self._value = value
+        self._done.set()
+
+    def _fail(self, error: BaseException) -> None:
+        self.latency_s = time.perf_counter() - self.submitted_at
+        self._error = error
+        self._done.set()
+
+    def result(self, timeout: Optional[float] = None) -> dict:
+        if not self._done.wait(timeout):
+            raise TimeoutError("serve request still pending")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class ServeMetrics:
+    """Thread-safe serving gauges; `payload()` is the schema'd
+    `serve/*` line (README "metrics.jsonl line format")."""
+
+    def __init__(self, slo_ms: float, window: int = 2048):
+        self.slo_ms = float(slo_ms)
+        self._lock = threading.Lock()
+        self._latencies_ms: deque = deque(maxlen=window)
+        self._bucket_counts: dict[int, int] = {}
+        self._valid_rows = 0
+        self._padded_rows = 0
+        self._completed = 0
+        self._violations = 0
+        self._started_at = time.perf_counter()
+        self._win_t0 = self._started_at
+        self._win_completed = 0
+
+    def record_request(self, latency_s: float) -> None:
+        ms = latency_s * 1e3
+        with self._lock:
+            self._latencies_ms.append(ms)
+            self._completed += 1
+            self._win_completed += 1
+            if ms > self.slo_ms:
+                self._violations += 1
+
+    def record_flush(self, executed: list[tuple[int, int]]) -> None:
+        with self._lock:
+            for bucket, valid in executed:
+                self._bucket_counts[bucket] = self._bucket_counts.get(bucket, 0) + 1
+                self._padded_rows += bucket
+                self._valid_rows += valid
+
+    def payload(self) -> dict:
+        """`serve/*` fields; qps is computed over the window since the
+        previous payload() call (the sink-flush cadence), falling back
+        to the lifetime rate on the first call."""
+        with self._lock:
+            now = time.perf_counter()
+            dt = max(now - self._win_t0, 1e-9)
+            qps = self._win_completed / dt
+            self._win_t0, self._win_completed = now, 0
+            lat = sorted(self._latencies_ms)
+            pct = lambda p: (
+                lat[min(int(p * (len(lat) - 1) + 0.5), len(lat) - 1)] if lat else None
+            )
+            out = {
+                "serve/p50_ms": pct(0.50),
+                "serve/p99_ms": pct(0.99),
+                "serve/qps": qps,
+                "serve/occupancy": (
+                    self._valid_rows / self._padded_rows if self._padded_rows else None
+                ),
+                "serve/requests": self._completed,
+                "serve/slo_violations": self._violations,
+                "serve/slo_ms": self.slo_ms,
+            }
+            for bucket, count in sorted(self._bucket_counts.items()):
+                out[f"serve/bucket_{bucket}"] = count
+            return out
+
+
+class ContinuousBatcher:
+    """Micro-batch coalescing front end over an engine-shaped callable
+    (module docstring).
+
+    `run_batch(images, want_neighbors) -> (dict of row-arrays, executed)`
+    — the server wires this to `engine.embed` / `engine.embed_and_query`;
+    every returned array's rows align with the input rows so the scatter
+    is a pure slice. `max_batch` defaults to the engine's largest bucket.
+    """
+
+    def __init__(
+        self,
+        run_batch: Callable,
+        max_batch: int,
+        slo_ms: float = 100.0,
+        queue_depth: int = 256,
+        metrics: Optional[ServeMetrics] = None,
+    ):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self._run_batch = run_batch
+        self.max_batch = int(max_batch)
+        self.slo_ms = float(slo_ms)
+        # half the SLO budget may be spent coalescing; the rest belongs
+        # to the compute + scatter
+        self.deadline_s = self.slo_ms / 2e3
+        self.metrics = metrics or ServeMetrics(slo_ms)
+        self._q: queue.Queue = queue.Queue(maxsize=queue_depth)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="serve_batcher", daemon=True
+        )
+        self._thread.start()
+
+    # -- client side -----------------------------------------------------
+
+    def submit(self, images: np.ndarray, want_neighbors: bool = False) -> ServeFuture:
+        """Enqueue an (n, H, W, C) uint8 request; returns its future.
+        Raises BatcherClosedError when the batcher is shut (including a
+        producer that was blocked on a full queue during close)."""
+        images = np.asarray(images, np.uint8)
+        if images.ndim != 4 or images.shape[0] < 1:
+            raise ValueError(f"request must be (n>=1, H, W, C) uint8, got {images.shape}")
+        fut = ServeFuture(images.shape[0], time.perf_counter(), want_neighbors)
+        if self._stop.is_set() or not _responsive_put(self._q, self._stop, (images, fut)):
+            raise BatcherClosedError("batcher is closed")
+        return fut
+
+    # -- batcher thread --------------------------------------------------
+
+    def _flush(self, pending: list) -> None:
+        if not pending:
+            return
+        images = np.concatenate([img for img, _ in pending])
+        want_neighbors = any(f.want_neighbors for _, f in pending)
+        try:
+            results, executed = self._run_batch(images, want_neighbors)
+        except BaseException as e:
+            for _, fut in pending:
+                fut._fail(e)
+            return
+        self.metrics.record_flush(executed)
+        offset = 0
+        for _, fut in pending:
+            rows = slice(offset, offset + fut.num_rows)
+            fut._resolve({k: v[rows] for k, v in results.items()})
+            offset += fut.num_rows
+            self.metrics.record_request(fut.latency_s)
+
+    def _loop(self) -> None:
+        pending: list = []
+        rows = 0
+        while not self._stop.is_set():
+            if pending:
+                timeout = self.deadline_s - (
+                    time.perf_counter() - pending[0][1].submitted_at
+                )
+                if timeout <= 0 or rows >= self.max_batch:
+                    self._flush(pending)
+                    pending, rows = [], 0
+                    continue
+            else:
+                timeout = 0.05  # idle poll so close() never waits long
+            try:
+                item = self._q.get(timeout=timeout)
+            except queue.Empty:
+                continue
+            images, fut = item
+            pending.append((images, fut))
+            rows += fut.num_rows
+            if rows >= self.max_batch:
+                self._flush(pending)
+                pending, rows = [], 0
+        # drain-on-stop: everything still queued or pending fails fast
+        # so no client blocks on a future that will never resolve
+        for _, fut in pending:
+            fut._fail(BatcherClosedError("batcher closed with request pending"))
+        while True:
+            try:
+                _, fut = self._q.get_nowait()
+            except queue.Empty:
+                break
+            fut._fail(BatcherClosedError("batcher closed with request queued"))
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Stop coalescing, fail all pending/queued futures, join the
+        thread. Safe from any thread, idempotent; put-blocked producers
+        unblock via their responsive-put stop poll."""
+        self._stop.set()
+        self._thread.join(timeout=timeout)
+        # a producer may have enqueued between the thread's drain and
+        # its exit — fail those too (the thread is gone; nobody else
+        # will ever take them)
+        while True:
+            try:
+                _, fut = self._q.get_nowait()
+            except queue.Empty:
+                break
+            fut._fail(BatcherClosedError("batcher is closed"))
+
+    @property
+    def closed(self) -> bool:
+        return self._stop.is_set()
+
+    def __del__(self):
+        self._stop.set()
+
+
+__all__ = [
+    "BatcherClosedError",
+    "ContinuousBatcher",
+    "ServeFuture",
+    "ServeMetrics",
+]

@@ -1,0 +1,290 @@
+"""AOT-compiled embedding inference over the exported encoder.
+
+Training compiles one step shape and amortizes it over an epoch;
+serving sees arbitrary request sizes, and a `jax.jit` that traces per
+shape would recompile on live traffic — exactly the
+recompile-after-warmup class mocolint's JX004 and the runtime
+`RecompileGuard` exist to abort. The engine therefore compiles *ahead
+of time*: one executable per padded batch bucket
+(`jit(...).lower(shapes).compile()`, default buckets {1, 8, 32, 128}),
+requests pad up to the next bucket, and after :meth:`mark_warm` any
+shape that would need a fresh trace raises :class:`EngineRecompileError`
+instead of silently compiling. `recompiles_after_warmup` is the gauge
+the serve smoke asserts at zero across mixed request sizes.
+
+Graph: uint8 images → /255 → per-channel normalize (the eval recipe
+`knn.extract_features` uses) → module forward in bf16 (the serving
+default — inference tolerates bf16 activations; params stay f32) →
+f32 cast → L2-normalize. The module is whatever representation the
+deployment serves: the FULL encoder (backbone + projection head, the
+`load_serving_encoder` default) embeds into the negative queue's space
+so the index can hold the trained dictionary, while a bare backbone
+serves kNN-style features. Input buffers are donated on backends with
+donation support and the donation is *audited*: :meth:`donation_audit`
+verifies post-hoc that each bucket's input buffer was actually consumed
+(deleted) by its call, so a silent donation regression (e.g. a wrapper
+holding a reference) shows up as a boolean, not a slow leak.
+
+Encoder side: the *key* (EMA) encoder by default — serving wants the
+slow-moving stable representation ("How to Scale Your EMA",
+arXiv:2307.13813), while probes/export keep the query side. The loader
+reuses `lincls.load_pretrained_backbone` (side="k"), so ZeRO-2/3
+checkpoints unshard through the same one-shot host gather as every
+other eval tool.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from moco_tpu.obs.trace import span as obs_span
+from moco_tpu.ops.losses import l2_normalize
+
+DEFAULT_BUCKETS = (1, 8, 32, 128)
+
+
+class EngineRecompileError(RuntimeError):
+    """A batch shape arrived after warmup that has no AOT executable —
+    the serving mirror of analysis/runtime.py's RecompileError."""
+
+
+def load_serving_encoder(
+    workdir: str, config=None, side: str = "k"
+) -> tuple[Any, Any, Any, np.ndarray, int, Any]:
+    """(encoder_module, params, batch_stats, queue, queue_ptr, config)
+    for serving from a pretraining checkpoint — the key (EMA) side by
+    default, and the FULL encoder (backbone + projection head): serving
+    embeds into the same space the negative queue lives in, so the
+    checkpoint's dictionary rows load straight into an EmbeddingIndex
+    (`EmbeddingIndex.from_train_queue`) and `/neighbors` is literally
+    the training look-up as a product. On accelerator backends the
+    encoder is rebuilt in bf16 regardless of the training compute dtype
+    (the serving default; params stay f32); CPU keeps f32 — XLA:CPU
+    *emulates* bf16 at a measured ~50x slowdown, which would poison the
+    CPU smoke and the bench serving leg. ZeRO-2/3 checkpoints unshard
+    through `lincls.restore_pretrain_state`, the shared eval-side
+    path."""
+    from moco_tpu.core.moco import build_encoder
+    from moco_tpu.lincls import restore_pretrain_state
+
+    if side not in ("q", "k"):
+        raise ValueError(f"side must be 'q' or 'k', got {side!r}")
+    state, config = restore_pretrain_state(workdir, config, unshard=(side,))
+    serve_dtype = (
+        "bfloat16" if jax.default_backend() in ("tpu", "gpu") else "float32"
+    )
+    encoder = build_encoder(dataclasses.replace(config.moco, compute_dtype=serve_dtype))
+    params = state.params_k if side == "k" else state.params_q
+    stats = state.batch_stats_k if side == "k" else state.batch_stats_q
+    return (
+        encoder,
+        jax.device_get(params),
+        jax.device_get(stats),
+        np.asarray(state.queue),
+        int(state.queue_ptr),
+        config,
+    )
+
+
+class InferenceEngine:
+    """Bucketed AOT inference: `embed` (and `embed_and_query` against an
+    `EmbeddingIndex`) over uint8 image batches of any size ≤ the largest
+    bucket × chunking (module docstring).
+
+    `mesh=None` runs single-device (the serving replica unit — scale-out
+    is N processes behind a balancer, not one sharded forward; the
+    *index* shards instead, see serve/index.py).
+    """
+
+    def __init__(
+        self,
+        module,
+        params: Any,
+        batch_stats: Any,
+        image_size: int,
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        donate: Optional[bool] = None,
+    ):
+        if not buckets or sorted(set(int(b) for b in buckets)) != sorted(
+            int(b) for b in buckets
+        ):
+            raise ValueError(f"buckets must be unique and non-empty, got {buckets}")
+        self.buckets = tuple(sorted(int(b) for b in buckets))
+        self.image_size = int(image_size)
+        self.num_features = getattr(module, "num_features", None)
+        if donate is None:
+            # CPU lacks donation support (jit would only warn and keep the
+            # buffer) — same backend gate as make_train_step's donate_nums
+            donate = jax.default_backend() in ("tpu", "gpu")
+        self.donate = bool(donate)
+        self._variables = {"params": params, "batch_stats": batch_stats}
+
+        from moco_tpu.data.augment import get_recipe, normalize
+
+        recipe = get_recipe(False, self.image_size)
+
+        def forward(raw):  # (b, H, W, C) uint8
+            x = raw.astype(jnp.float32) / 255.0
+            x = normalize(x, recipe.mean, recipe.std)
+            feats = module.apply(self._variables, x, train=False)
+            return l2_normalize(feats.astype(jnp.float32))
+
+        self._forward = forward
+        self._compiled: dict[int, object] = {}
+        self._frozen = False
+        self.aot_compiles = 0
+        self._warm_compiles: Optional[int] = None
+        self._donation_audit: dict[int, Optional[bool]] = {}
+        for b in self.buckets:
+            self._compile(b)
+
+    # -- compilation -----------------------------------------------------
+
+    def _compile(self, bucket: int):
+        if self._frozen:
+            raise EngineRecompileError(
+                f"batch bucket {bucket} has no AOT executable and the engine "
+                "is warm — pad requests to a compiled bucket "
+                f"{self.buckets} instead of tracing on live traffic"
+            )
+        jitted = jax.jit(
+            self._forward, donate_argnums=(0,) if self.donate else ()
+        )
+        shape = jax.ShapeDtypeStruct(
+            (bucket, self.image_size, self.image_size, 3), jnp.uint8
+        )
+        with obs_span("serve_aot_compile", bucket=bucket):
+            compiled = jitted.lower(shape).compile()
+        self.aot_compiles += 1
+        self._compiled[bucket] = compiled
+        return compiled
+
+    def warmup(self) -> None:
+        """Execute every bucket once (primes allocator/layout work the
+        compile alone doesn't) and freeze: from here on an uncompiled
+        shape raises instead of tracing. Blocks until the warmup work
+        actually ran — otherwise the async dispatches queue up and the
+        FIRST real request pays for all of them (observed: ~20s of
+        deferred bucket executions landing on one request)."""
+        for b in self.buckets:
+            out = self._run_bucket(
+                np.zeros((b, self.image_size, self.image_size, 3), np.uint8)
+            )
+            out.block_until_ready()
+        self.mark_warm()
+
+    def mark_warm(self) -> None:
+        self._frozen = True
+        self._warm_compiles = self.aot_compiles
+
+    @property
+    def recompiles_after_warmup(self) -> int:
+        if self._warm_compiles is None:
+            return 0
+        return self.aot_compiles - self._warm_compiles
+
+    def donation_audit(self) -> dict[int, Optional[bool]]:
+        """Per-bucket: True = the donated input buffer was consumed by
+        the call (deleted — donation is real), False = donation was
+        requested but the buffer survived (a reference leak would
+        double peak memory per request), None = donation disabled
+        (backend without support). Populated lazily as buckets run."""
+        return dict(self._donation_audit)
+
+    # -- execution -------------------------------------------------------
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest compiled bucket holding n rows (n ≤ max bucket)."""
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"batch of {n} exceeds the largest bucket {self.buckets[-1]}")
+
+    def _run_bucket(self, padded: np.ndarray) -> jax.Array:
+        """One compiled call on an exactly-bucket-shaped uint8 batch."""
+        bucket = padded.shape[0]
+        compiled = self._compiled.get(bucket)
+        if compiled is None:
+            compiled = self._compile(bucket)
+        staged = jax.device_put(jnp.asarray(padded, jnp.uint8))
+        out = compiled(staged)
+        if bucket not in self._donation_audit:
+            if self.donate:
+                out.block_until_ready()
+                self._donation_audit[bucket] = bool(staged.is_deleted())
+            else:
+                self._donation_audit[bucket] = None
+        return out
+
+    def _padded_chunks(self, images: np.ndarray):
+        """Yield (padded_uint8, valid_rows, bucket): chunk at the
+        largest bucket, pad each chunk with zero rows to its bucket."""
+        images = np.asarray(images, np.uint8)
+        if images.ndim != 4 or images.shape[1:] != (self.image_size, self.image_size, 3):
+            raise ValueError(
+                f"expected (n, {self.image_size}, {self.image_size}, 3) uint8, "
+                f"got {images.shape}"
+            )
+        max_b = self.buckets[-1]
+        for start in range(0, images.shape[0], max_b):
+            chunk = images[start : start + max_b]
+            bucket = self.bucket_for(chunk.shape[0])
+            padded = chunk
+            if bucket != chunk.shape[0]:
+                padded = np.zeros((bucket,) + chunk.shape[1:], np.uint8)
+                padded[: chunk.shape[0]] = chunk
+            yield padded, chunk.shape[0], bucket
+
+    def embed(
+        self, images: np.ndarray
+    ) -> tuple[np.ndarray, list[Tuple[int, int]]]:
+        """L2-normalized (n, num_features) f32 embeddings of an
+        (n, H, W, C) uint8 batch, plus the executed (bucket, valid_rows)
+        pairs for occupancy accounting. Oversized batches chunk at the
+        largest bucket; padding rows are zeros and their outputs are
+        sliced away before anything downstream sees them."""
+        outs, executed = [], []
+        for padded, n, bucket in self._padded_chunks(images):
+            with obs_span("serve_embed", bucket=bucket, valid=n):
+                feats = self._run_bucket(padded)
+            outs.append(np.asarray(feats)[:n])
+            executed.append((bucket, n))
+        return np.concatenate(outs), executed
+
+    def embed_and_query(
+        self, images: np.ndarray, index, k: int
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray, list[Tuple[int, int]]]:
+        """(embeddings, scores, indices, executed) — the `/neighbors`
+        path. The index query runs on the PADDED bucket rows (the same
+        shapes `index.prepare(self.buckets, k)` AOT-compiled), so mixed
+        request sizes never trace; padding rows' neighbors are sliced
+        away with their embeddings."""
+        outs, scores_out, idx_out, executed = [], [], [], []
+        for padded, n, bucket in self._padded_chunks(images):
+            with obs_span("serve_embed", bucket=bucket, valid=n):
+                feats = self._run_bucket(padded)  # (bucket, d) on device
+            with obs_span("serve_query", bucket=bucket, k=k):
+                scores, idx = index.query(feats, k)  # padded-bucket shape
+            outs.append(np.asarray(feats)[:n])
+            scores_out.append(scores[:n])
+            idx_out.append(idx[:n])
+            executed.append((bucket, n))
+        return (
+            np.concatenate(outs),
+            np.concatenate(scores_out),
+            np.concatenate(idx_out),
+            executed,
+        )
+
+
+__all__ = [
+    "DEFAULT_BUCKETS",
+    "EngineRecompileError",
+    "InferenceEngine",
+    "load_serving_encoder",
+]

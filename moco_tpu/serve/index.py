@@ -1,0 +1,256 @@
+"""The embedding index — MoCo's dictionary, factored out of the queue.
+
+MoCo's framing is "contrastive learning as dictionary look-up"
+(arXiv:1911.05722): training scores queries against a FIFO dictionary
+of key embeddings, and serving scores user queries against the same
+kind of store. Until this module those two look-ups were separate
+implementations — `core/queue.py` owned the FIFO write, `knn.py` owned
+its own cosine top-k scan, and nothing served either. Both now rehost
+on the two kernels here:
+
+- :func:`fifo_write` — the FIFO block write (`dynamic_update_slice` at
+  `ptr`, no wrap because callers keep K % block == 0). `core/queue.py`'s
+  `enqueue` delegates here bit-for-bit, so the train-time queue IS the
+  train-time instance of the index (the equivalence test in
+  tests/test_serve.py pins this).
+- :func:`topk_cosine` — the top-k cosine scan (one matmul + lax.top_k,
+  optional valid-row mask). `knn.py`'s classifier and the serving
+  `/neighbors` endpoint both call it.
+
+:class:`EmbeddingIndex` wraps the kernels into the serving-side store:
+rows live on device — optionally P(data)-sharded over a mesh, so the
+scan's (m, K) matmul shards its contraction over the data axis exactly
+like the model-sharded queue shards InfoNCE logits — with FIFO and
+snapshot ingest, and an AOT-compiled query per padded query bucket so
+serving traffic can never trigger a recompile (mocolint JX004 /
+RecompileGuard discipline; serve/engine.py's bucket set is reused).
+
+The scan is exact (brute-force top-k over every valid row), which at
+MoCo dictionary sizes (K ≤ 65536, dim ≤ 256) is one small matmul —
+far below the engine's encoder forward. Approximate structures only
+pay above ~10^7 rows; the class is the seam where one would slot in.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from moco_tpu.ops.losses import l2_normalize
+from moco_tpu.parallel.mesh import DATA_AXIS
+
+
+def fifo_write(
+    rows: jax.Array, ptr: jax.Array, values: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """FIFO block write of `values` (N, dim) at `ptr`; returns
+    (rows, new_ptr). The write never wraps — callers maintain
+    K % N == 0 (the reference queue invariant, `moco/builder.py:~L70`),
+    so one `dynamic_update_slice` suffices. Bit-identical to the
+    pre-refactor `core/queue.enqueue` body, which now delegates here."""
+    num_rows = rows.shape[0]
+    values = jax.lax.stop_gradient(values).astype(rows.dtype)
+    rows = jax.lax.dynamic_update_slice(rows, values, (ptr, jnp.zeros_like(ptr)))
+    new_ptr = (ptr + values.shape[0]) % num_rows
+    return rows, new_ptr
+
+
+def topk_cosine(
+    queries: jax.Array,  # (m, dim) L2-normalized
+    rows: jax.Array,  # (K, dim) L2-normalized
+    k: int,
+    valid_count: Optional[jax.Array] = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Top-k cosine scores + row indices of `queries` against `rows`.
+
+    One (m, K) matmul + `lax.top_k` — the shared scan `knn.knn_classify`
+    and the serving `/neighbors` path both rehost on. `valid_count`
+    (dynamic scalar) masks rows at index >= count to -inf so a
+    partially-filled index never surfaces uninitialized rows; passing it
+    as a traced value means fill level changes never recompile."""
+    sims = queries @ rows.T  # cosine: inputs are L2-normalized
+    if valid_count is not None:
+        invalid = jnp.arange(rows.shape[0]) >= valid_count
+        sims = jnp.where(invalid[None, :], -jnp.inf, sims)
+    return jax.lax.top_k(sims, k)
+
+
+class IndexRecompileError(RuntimeError):
+    """A query shape arrived that was not AOT-compiled at prepare()
+    time — serving must pad to a prepared bucket, never trace anew."""
+
+
+class EmbeddingIndex:
+    """Device-resident embedding store with FIFO/snapshot ingest and an
+    AOT-bucketed exact top-k cosine query (module docstring).
+
+    `mesh` shards the rows P(data, None) — capacity is padded up to a
+    multiple of the data-axis width so the shard is rectangular; padded
+    rows sit above `count` and are masked out of every query. Without a
+    mesh the rows live replicated on the default device.
+    """
+
+    def __init__(
+        self,
+        capacity: int,
+        dim: int,
+        mesh=None,
+        dtype=jnp.float32,
+    ):
+        if capacity < 1:
+            raise ValueError(f"index capacity must be >= 1, got {capacity}")
+        self.dim = int(dim)
+        self.mesh = mesh
+        self._n_data = mesh.shape[DATA_AXIS] if mesh is not None else 1
+        # rectangular shard: pad capacity up to a multiple of the axis
+        self.capacity = -(-int(capacity) // self._n_data) * self._n_data
+        self.requested_capacity = int(capacity)
+        self.count = 0  # valid rows (host-side; queries read a device copy)
+        self._ptr = 0  # FIFO write head (host-side mirror)
+        self._row_sharding = None
+        rows = jnp.zeros((self.capacity, self.dim), dtype)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            self._row_sharding = NamedSharding(mesh, P(DATA_AXIS, None))
+            rows = jax.device_put(rows, self._row_sharding)
+        self.rows = rows
+        self._compiled: dict[tuple[int, int], object] = {}
+        self._frozen = False
+        self.aot_compiles = 0
+        self._warm_compiles: Optional[int] = None
+
+    # -- ingest ----------------------------------------------------------
+
+    def snapshot(self, embeddings: np.ndarray, normalized: bool = True) -> None:
+        """Bulk (re)load: replace the store's contents with `embeddings`
+        (n <= capacity rows) — the "load the trained dictionary" path
+        (e.g. a checkpoint's queue). Resets the FIFO head."""
+        embs = np.asarray(embeddings)
+        n = embs.shape[0]
+        if n > self.capacity or embs.shape[1] != self.dim:
+            raise ValueError(
+                f"snapshot shape {embs.shape} exceeds index ({self.capacity}, {self.dim})"
+            )
+        if not normalized:
+            embs = np.asarray(l2_normalize(jnp.asarray(embs)))
+        full = np.zeros((self.capacity, self.dim), self.rows.dtype)
+        full[:n] = embs
+        rows = jnp.asarray(full)
+        if self._row_sharding is not None:
+            rows = jax.device_put(rows, self._row_sharding)
+        self.rows = rows
+        self.count = n
+        self._ptr = n % self.capacity
+
+    def add(self, embeddings: np.ndarray) -> None:
+        """FIFO ingest of an (N, dim) block at the write head — the
+        serving-side mirror of the training enqueue. N must divide the
+        capacity (the same no-wrap invariant `fifo_write` relies on)."""
+        embs = jnp.asarray(embeddings, self.rows.dtype)
+        n = embs.shape[0]
+        if n == 0:
+            return
+        if self.capacity % n:
+            raise ValueError(
+                f"FIFO block of {n} rows does not divide capacity {self.capacity} "
+                "(the no-wrap invariant); use snapshot() for arbitrary sizes"
+            )
+        rows, _ = fifo_write(self.rows, jnp.int32(self._ptr), embs)
+        if self._row_sharding is not None:
+            rows = jax.device_put(rows, self._row_sharding)
+        self.rows = rows
+        self._ptr = (self._ptr + n) % self.capacity
+        self.count = min(self.count + n, self.capacity)
+
+    @classmethod
+    def from_train_queue(
+        cls, queue: jax.Array, queue_ptr=0, count: Optional[int] = None, mesh=None
+    ) -> "EmbeddingIndex":
+        """The train-time queue as an index: wrap a checkpoint's
+        (K, dim) queue rows (already L2-normalized by `init_queue`/
+        `enqueue`). `count=None` treats every row as valid — after
+        warmup the training queue is always full."""
+        rows = np.asarray(queue)
+        idx = cls(rows.shape[0], rows.shape[1], mesh=mesh, dtype=rows.dtype)
+        idx.snapshot(rows)
+        idx.count = rows.shape[0] if count is None else int(count)
+        idx._ptr = int(queue_ptr)
+        return idx
+
+    # -- query -----------------------------------------------------------
+
+    def _compile(self, m: int, k: int):
+        if self._frozen:
+            raise IndexRecompileError(
+                f"query shape (m={m}, k={k}) was not prepared before freeze() — "
+                "serving must pad queries to a prepared bucket (engine bucket "
+                "set); compiling now would be the recompile-after-warmup class "
+                "RecompileGuard aborts on"
+            )
+        fn = lambda q, rows, valid: topk_cosine(q, rows, k, valid_count=valid)
+        q_s = jax.ShapeDtypeStruct((m, self.dim), self.rows.dtype)
+        rows_s = jax.ShapeDtypeStruct(self.rows.shape, self.rows.dtype)
+        valid_s = jax.ShapeDtypeStruct((), jnp.int32)
+        if self._row_sharding is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            rep = NamedSharding(self.mesh, P())
+            jitted = jax.jit(
+                fn,
+                in_shardings=(rep, self._row_sharding, rep),
+                out_shardings=rep,
+            )
+        else:
+            jitted = jax.jit(fn)
+        compiled = jitted.lower(q_s, rows_s, valid_s).compile()
+        self.aot_compiles += 1
+        self._compiled[(m, k)] = compiled
+        return compiled
+
+    def prepare(self, buckets: Sequence[int], k: int) -> None:
+        """AOT-compile the query for every padded bucket shape (one
+        executable per (m, k)); serve traffic then never traces."""
+        for m in buckets:
+            if (int(m), int(k)) not in self._compiled:
+                self._compile(int(m), int(k))
+
+    def freeze(self) -> None:
+        """End of warmup: any later unprepared shape raises
+        IndexRecompileError instead of silently compiling."""
+        self._frozen = True
+        self._warm_compiles = self.aot_compiles
+
+    @property
+    def recompiles_after_warmup(self) -> int:
+        if self._warm_compiles is None:
+            return 0
+        return self.aot_compiles - self._warm_compiles
+
+    def query(
+        self, queries, k: int
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """(scores, indices), each (m, k), of the top-k valid rows per
+        query. `m` must be a prepared bucket once frozen; `k` is capped
+        by the caller to `count` if exact-rank semantics matter (indices
+        past the fill level never appear — their scores are -inf-masked
+        and top_k orders them last only when k > count)."""
+        q = jnp.asarray(queries, self.rows.dtype)
+        m = q.shape[0]
+        k = int(k)
+        compiled = self._compiled.get((m, k))
+        if compiled is None:
+            compiled = self._compile(m, k)
+        scores, idx = compiled(q, self.rows, jnp.int32(self.count))
+        return np.asarray(scores), np.asarray(idx)
+
+
+__all__ = [
+    "EmbeddingIndex",
+    "IndexRecompileError",
+    "fifo_write",
+    "topk_cosine",
+]

@@ -1,0 +1,232 @@
+"""The embedding service's HTTP front end (stdlib, like the Prometheus
+sink it runs alongside).
+
+Endpoints:
+
+- `POST /embed` — body: raw uint8 pixels, `X-Image-Shape: n,h,w,c`
+  header (h/w/c must match the engine). Response JSON:
+  `{"embedding": [[...f32...]]}` (L2-normalized backbone features).
+- `POST /neighbors` — same body; `?k=5` (default 5, capped at the
+  prepared k). Response adds `{"indices": [[...]], "scores": [[...]]}`
+  — top-k cosine rows of the sharded EmbeddingIndex, i.e. the MoCo
+  dictionary look-up as a product.
+- `GET /stats` — the live `serve/*` gauge snapshot as JSON.
+- `GET /healthz` — `{"ok": true, "warm": ...}` once the AOT warmup ran.
+
+Request rows flow through the ContinuousBatcher (coalescing under the
+SLO), so concurrent clients share padded-bucket executions; handler
+threads only block on their own future. Metrics flow into the standard
+obs sinks: a flusher thread writes `ServeMetrics.payload()` every
+`metrics_flush_s` (schema-validated `serve/*` family; with a Prometheus
+sink attached each gauge is scraped as `moco_serve_<name>`).
+
+Ports: `resolve_serve_port` (obs/sinks.py) applies the offset rule so
+a process running both the server and `--metrics-port` can't collide —
+Prometheus owns `metrics_port + process_index`, the server claims
+`serve_port + process_index` and shifts by SERVE_PORT_STRIDE when the
+two meet.
+
+Thread hygiene (JX011): the HTTP server thread and the metrics flusher
+are both joined in `close()`, the flusher polls a stop event, and the
+batcher's own close fails stragglers loudly.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import threading
+
+import numpy as np
+
+from moco_tpu.obs.sinks import resolve_serve_port  # noqa: F401  (public API)
+from moco_tpu.serve.batcher import BatcherClosedError, ContinuousBatcher, ServeMetrics
+
+DEFAULT_NEIGHBORS_K = 5
+
+
+class ServeServer:
+    """HTTP front end binding engine + index + batcher (module
+    docstring). `port=0` binds ephemeral (tests/smoke); `self.port` is
+    the actual one. `index=None` serves `/embed` only (`/neighbors`
+    answers 503). `sink=None` keeps metrics in-process (`/stats` only).
+    """
+
+    def __init__(
+        self,
+        engine,
+        index=None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        metrics_port: int = 0,
+        process_index: int = 0,
+        slo_ms: float = 100.0,
+        neighbors_k: int = DEFAULT_NEIGHBORS_K,
+        sink=None,
+        metrics_flush_s: float = 1.0,
+        warmup: bool = True,
+    ):
+        self.engine = engine
+        self.index = index
+        self.neighbors_k = int(neighbors_k)
+        self.metrics = ServeMetrics(slo_ms)
+        self._sink = sink
+        self._flush_step = 0
+        if warmup:
+            engine.warmup()
+            if index is not None:
+                index.prepare(engine.buckets, self.neighbors_k)
+                index.freeze()
+        self.batcher = ContinuousBatcher(
+            self._run_batch,
+            max_batch=engine.buckets[-1],
+            slo_ms=slo_ms,
+            metrics=self.metrics,
+        )
+        server = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                path = self.path.split("?")[0]
+                if path == "/healthz":
+                    self._json(200, {"ok": True, "warm": server.engine.recompiles_after_warmup == 0})
+                elif path == "/stats":
+                    self._json(200, server.stats())
+                else:
+                    self.send_error(404)
+
+            def do_POST(self):  # noqa: N802
+                path, _, query = self.path.partition("?")
+                if path not in ("/embed", "/neighbors"):
+                    self.send_error(404)
+                    return
+                try:
+                    images = self._read_images()
+                except ValueError as e:
+                    self._json(400, {"error": str(e)})
+                    return
+                want_neighbors = path == "/neighbors"
+                if want_neighbors and server.index is None:
+                    self._json(503, {"error": "no embedding index attached"})
+                    return
+                try:
+                    fut = server.batcher.submit(images, want_neighbors=want_neighbors)
+                    out = fut.result(timeout=30.0)
+                except (BatcherClosedError, TimeoutError) as e:
+                    self._json(503, {"error": str(e)})
+                    return
+                body = {"embedding": out["embedding"].tolist()}
+                if want_neighbors:
+                    k = _query_k(query, server.neighbors_k)
+                    body["indices"] = out["indices"][:, :k].tolist()
+                    body["scores"] = out["scores"][:, :k].tolist()
+                self._json(200, body)
+
+            def _read_images(self) -> np.ndarray:
+                shape_hdr = self.headers.get("X-Image-Shape", "")
+                try:
+                    shape = tuple(int(s) for s in shape_hdr.split(","))
+                except ValueError:
+                    raise ValueError(f"bad X-Image-Shape header {shape_hdr!r}")
+                if len(shape) != 4:
+                    raise ValueError("X-Image-Shape must be 'n,h,w,c'")
+                n = int(self.headers.get("Content-Length", 0))
+                expected = 1
+                for s in shape:
+                    expected *= s
+                if n != expected:
+                    raise ValueError(
+                        f"Content-Length {n} != prod(X-Image-Shape) {expected}"
+                    )
+                return np.frombuffer(self.rfile.read(n), np.uint8).reshape(shape)
+
+            def _json(self, code: int, obj: dict) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # silence per-request stderr lines
+                pass
+
+        resolved = resolve_serve_port(port, metrics_port, process_index)
+        self._server = http.server.ThreadingHTTPServer((host, resolved), Handler)
+        self.host = host
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="serve_http", daemon=True
+        )
+        self._thread.start()
+        self._stop = threading.Event()
+        self._flusher = threading.Thread(
+            target=self._flush_loop, args=(float(metrics_flush_s),),
+            name="serve_metrics_flush", daemon=True,
+        )
+        self._flusher.start()
+
+    # -- request path ----------------------------------------------------
+
+    def _run_batch(self, images, want_neighbors):
+        """Batcher thread body: one padded engine execution per flush.
+        Neighbors are computed for the whole micro-batch when ANY rider
+        wants them (the index scan is a small matmul next to the encoder
+        forward); /embed riders just drop the extra keys at scatter."""
+        if want_neighbors and self.index is not None:
+            emb, scores, idx, executed = self.engine.embed_and_query(
+                images, self.index, self.neighbors_k
+            )
+            return {"embedding": emb, "scores": scores, "indices": idx}, executed
+        emb, executed = self.engine.embed(images)
+        return {"embedding": emb}, executed
+
+    # -- metrics ---------------------------------------------------------
+
+    def stats(self) -> dict:
+        out = self.metrics.payload()
+        out["serve/recompiles_after_warmup"] = self.engine.recompiles_after_warmup
+        if self.index is not None:
+            out["serve/index_rows"] = self.index.count
+            out["serve/recompiles_after_warmup"] += self.index.recompiles_after_warmup
+        return out
+
+    def _flush_loop(self, interval: float) -> None:
+        while not self._stop.wait(interval):
+            self._write_metrics()
+
+    def _write_metrics(self) -> None:
+        if self._sink is None:
+            return
+        self._flush_step += 1
+        try:
+            self._sink.write(self._flush_step, self.stats())
+        except Exception as e:  # metrics must never take serving down
+            print(f"WARNING: serve metrics sink failed: {e!r}", flush=True)
+
+    # -- lifecycle -------------------------------------------------------
+
+    def close(self) -> None:
+        """Shut down HTTP, batcher, and flusher; join all three threads
+        (the obs/sinks.py PrometheusSink close discipline). A final
+        metrics flush lands the run's last gauges in the sink."""
+        self._stop.set()
+        self._flusher.join(timeout=5.0)
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5.0)
+        self.batcher.close()
+        self._write_metrics()
+
+
+def _query_k(query: str, default: int) -> int:
+    for part in query.split("&"):
+        if part.startswith("k="):
+            try:
+                return max(1, min(int(part[2:]), default))
+            except ValueError:
+                break
+    return default
+
+
+__all__ = ["DEFAULT_NEIGHBORS_K", "ServeServer", "resolve_serve_port"]

@@ -52,6 +52,7 @@ TRACKED_FIELDS = (
     "hbm_peak_bytes",
     "comms_total_bytes_per_step",
     "zero_ab",
+    "serving",
     "legs",
 )
 
@@ -103,18 +104,24 @@ def append(ledger_path: str, input_path: str, run_id: str, note: str | None = No
     return entry
 
 
-def check(ledger_path: str, input_path: str, threshold: float | None = None) -> int:
-    """0 = pass (or no comparable leg), 1 = regression beyond threshold."""
-    ledger = load_ledger(ledger_path)
-    rec = load_bench_record(input_path)
-    metric, value = rec["metric"], rec.get("value")
+def _gate_series(
+    ledger: dict,
+    metric: str,
+    value,
+    threshold: float | None,
+    get_series,
+) -> int:
+    """Gate one (metric, value) series against the most recent ledger
+    entry for which `get_series(entry)` yields the same metric. 0 =
+    pass or no comparable entry; 1 = regression beyond threshold."""
     if value is None:
         print(f"perf gate: candidate has no value for {metric} — nothing to gate")
         return 0
-    baseline = None
+    baseline = base_entry = None
     for e in reversed(ledger["entries"]):
-        if e.get("metric") == metric and e.get("value") is not None:
-            baseline = e
+        s = get_series(e)
+        if s and s.get("metric") == metric and s.get("value") is not None:
+            baseline, base_entry = s, e
             break
     if baseline is None:
         print(
@@ -128,15 +135,43 @@ def check(ledger_path: str, input_path: str, threshold: float | None = None) -> 
     verdict = "PASS" if value >= floor else "FAIL"
     print(
         f"perf gate [{verdict}] {metric}: {value:.2f} vs {baseline['value']:.2f} "
-        f"(run {baseline.get('run_id')}, {delta:+.1f}%, threshold -{thr * 100:.0f}%)"
+        f"(run {base_entry.get('run_id')}, {delta:+.1f}%, threshold -{thr * 100:.0f}%)"
     )
+    return 0 if verdict == "PASS" else 1
+
+
+def check(ledger_path: str, input_path: str, threshold: float | None = None) -> int:
+    """0 = every series passes (or has no comparable leg); 1 = any
+    regression beyond threshold. Two gated series per record: the
+    training headline (`metric`/`value`) and — since the serving
+    subsystem — the serving headline (`serving.metric`/`serving.value`,
+    queries/s/chip at the fixed SLO), each against the most recent
+    ledger entry carrying the same metric name."""
+    ledger = load_ledger(ledger_path)
+    rec = load_bench_record(input_path)
+    rc = _gate_series(ledger, rec["metric"], rec.get("value"), threshold, lambda e: e)
+    serving = rec.get("serving")
+    if serving and serving.get("metric"):
+        rc |= _gate_series(
+            ledger,
+            serving["metric"],
+            serving.get("value"),
+            threshold,
+            lambda e: e.get("serving"),
+        )
     # informational deltas for the secondary series (never gating —
     # they gate the day they prove stable enough)
-    for k in ("mfu", "with_data_imgs_per_sec_per_chip", "overlap_efficiency"):
-        a, b = rec.get(k), baseline.get(k)
-        if a is not None and b is not None and b:
-            print(f"  {k}: {a} vs {b} ({(a - b) / b * 100.0:+.1f}%)")
-    return 0 if verdict == "PASS" else 1
+    baseline = None
+    for e in reversed(ledger["entries"]):
+        if e.get("metric") == rec["metric"] and e.get("value") is not None:
+            baseline = e
+            break
+    if baseline is not None:
+        for k in ("mfu", "with_data_imgs_per_sec_per_chip", "overlap_efficiency"):
+            a, b = rec.get(k), baseline.get(k)
+            if a is not None and b is not None and b:
+                print(f"  {k}: {a} vs {b} ({(a - b) / b * 100.0:+.1f}%)")
+    return rc
 
 
 def show(ledger_path: str) -> int:
