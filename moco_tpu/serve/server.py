@@ -7,11 +7,24 @@ Endpoints:
   header (h/w/c must match the engine). Response JSON:
   `{"embedding": [[...f32...]]}` (L2-normalized backbone features).
 - `POST /neighbors` — same body; `?k=5` (default 5, capped at the
-  prepared k). Response adds `{"indices": [[...]], "scores": [[...]]}`
-  — top-k cosine rows of the sharded EmbeddingIndex, i.e. the MoCo
-  dictionary look-up as a product.
+  prepared k) and `?mode=exact|ivf|exact_i8|ivf_i8` (default: the
+  server's `neighbors_mode`). Response adds
+  `{"indices": [[...]], "scores": [[...]], "mode": "..."}` — top-k
+  cosine rows of the sharded EmbeddingIndex, i.e. the MoCo dictionary
+  look-up as a product; `ivf` scans only the `nprobe` nearest cells
+  (sub-linear — serve/index.py), the int8 modes score quantized.
+- `POST /ingest` — body: raw float32 rows, `X-Rows-Shape: n,d` header.
+  FIFO-ingests a block into the live index (the streaming-updates path
+  `scripts/serve_ingest.py` drives from a training checkpoint dir);
+  IVF cell membership and the int8 mirror follow incrementally.
 - `GET /stats` — the live `serve/*` gauge snapshot as JSON.
 - `GET /healthz` — `{"ok": true, "warm": ...}` once the AOT warmup ran.
+
+Recall estimation: with an approximate `neighbors_mode`, every
+`recall_sample_every`-th neighbors micro-batch ALSO runs the exact
+oracle on the same device features and records the top-k overlap —
+`serve/recall_estimate` in the metric flush, the gauge the smoke's
+recall floor (and the CONTRIBUTING review gate) reads.
 
 Request rows flow through the ContinuousBatcher (coalescing under the
 SLO), so concurrent clients share padded-bucket executions; handler
@@ -41,8 +54,10 @@ import numpy as np
 
 from moco_tpu.obs.sinks import resolve_serve_port  # noqa: F401  (public API)
 from moco_tpu.serve.batcher import BatcherClosedError, ContinuousBatcher, ServeMetrics
+from moco_tpu.serve.index import QUERY_MODES
 
 DEFAULT_NEIGHBORS_K = 5
+DEFAULT_RECALL_SAMPLE_EVERY = 8
 
 
 class ServeServer:
@@ -62,21 +77,46 @@ class ServeServer:
         process_index: int = 0,
         slo_ms: float = 100.0,
         neighbors_k: int = DEFAULT_NEIGHBORS_K,
+        neighbors_mode: str = "exact",
+        nprobe: int = 0,
+        recall_sample_every: int = DEFAULT_RECALL_SAMPLE_EVERY,
         sink=None,
         metrics_flush_s: float = 1.0,
         warmup: bool = True,
     ):
+        if neighbors_mode not in QUERY_MODES:
+            raise ValueError(
+                f"neighbors_mode must be one of {QUERY_MODES}, got {neighbors_mode!r}"
+            )
         self.engine = engine
         self.index = index
         self.neighbors_k = int(neighbors_k)
+        self.neighbors_mode = neighbors_mode
+        self.nprobe = int(nprobe) or None
+        self.recall_sample_every = int(recall_sample_every)
         self.metrics = ServeMetrics(slo_ms)
         self._sink = sink
         self._flush_step = 0
+        self._neighbor_flushes = 0
+        self.ingested_rows = 0
+        # one lock covers every index touch: a donated ingest write must
+        # never invalidate a rows buffer a query is reading mid-flight
+        self._index_lock = threading.Lock()
         if warmup:
             engine.warmup()
             if index is not None:
-                index.prepare(engine.buckets, self.neighbors_k)
+                # the exact tier is always prepared: it is the oracle the
+                # recall estimator scores against and the fallback tier
+                modes = {"exact", neighbors_mode}
+                index.prepare(
+                    engine.buckets, self.neighbors_k,
+                    nprobe=self.nprobe, modes=sorted(modes),
+                )
                 index.freeze()
+                self._prepared_modes = modes
+        if not hasattr(self, "_prepared_modes"):
+            # warmup=False: the caller prepared the index; accept any mode
+            self._prepared_modes = set(QUERY_MODES)
         self.batcher = ContinuousBatcher(
             self._run_batch,
             max_batch=engine.buckets[-1],
@@ -97,6 +137,9 @@ class ServeServer:
 
             def do_POST(self):  # noqa: N802
                 path, _, query = self.path.partition("?")
+                if path == "/ingest":
+                    self._handle_ingest()
+                    return
                 if path not in ("/embed", "/neighbors"):
                     self.send_error(404)
                     return
@@ -109,8 +152,21 @@ class ServeServer:
                 if want_neighbors and server.index is None:
                     self._json(503, {"error": "no embedding index attached"})
                     return
+                mode = None
+                if want_neighbors:
+                    mode = _query_param(query, "mode")
+                    if mode is not None and (
+                        mode not in QUERY_MODES or mode not in server._prepared_modes
+                    ):
+                        self._json(400, {
+                            "error": f"mode {mode!r} not prepared on this replica "
+                            f"(serving: {sorted(server._prepared_modes)})"
+                        })
+                        return
                 try:
-                    fut = server.batcher.submit(images, want_neighbors=want_neighbors)
+                    fut = server.batcher.submit(
+                        images, want_neighbors=want_neighbors, mode=mode
+                    )
                     out = fut.result(timeout=30.0)
                 except (BatcherClosedError, TimeoutError) as e:
                     self._json(503, {"error": str(e)})
@@ -118,9 +174,48 @@ class ServeServer:
                 body = {"embedding": out["embedding"].tolist()}
                 if want_neighbors:
                     k = _query_k(query, server.neighbors_k)
-                    body["indices"] = out["indices"][:, :k].tolist()
-                    body["scores"] = out["scores"][:, :k].tolist()
+                    eff = mode or server.neighbors_mode
+                    body["indices"] = out[f"indices:{eff}"][:, :k].tolist()
+                    body["scores"] = out[f"scores:{eff}"][:, :k].tolist()
+                    body["mode"] = eff
                 self._json(200, body)
+
+            def _handle_ingest(self):
+                """FIFO-ingest a raw f32 row block into the live index —
+                the wire the streaming updater (scripts/serve_ingest.py)
+                pushes fresh training-queue rows over."""
+                if server.index is None:
+                    self._json(503, {"error": "no embedding index attached"})
+                    return
+                try:
+                    shape_hdr = self.headers.get("X-Rows-Shape", "")
+                    try:
+                        n, d = (int(s) for s in shape_hdr.split(","))
+                    except ValueError:
+                        raise ValueError(f"bad X-Rows-Shape header {shape_hdr!r}")
+                    if d != server.index.dim:
+                        raise ValueError(
+                            f"row dim {d} != index dim {server.index.dim}"
+                        )
+                    length = int(self.headers.get("Content-Length", 0))
+                    if length != n * d * 4:
+                        raise ValueError(
+                            f"Content-Length {length} != n*d*4 = {n * d * 4}"
+                        )
+                    rows = np.frombuffer(
+                        self.rfile.read(length), np.float32
+                    ).reshape(n, d)
+                    with server._index_lock:
+                        server.index.add(rows)
+                        server.ingested_rows += n
+                except ValueError as e:
+                    self._json(400, {"error": str(e)})
+                    return
+                self._json(200, {
+                    "ingested": n,
+                    "index_rows": server.index.count,
+                    "total_ingested": server.ingested_rows,
+                })
 
             def _read_images(self) -> np.ndarray:
                 shape_hdr = self.headers.get("X-Image-Shape", "")
@@ -168,16 +263,46 @@ class ServeServer:
 
     # -- request path ----------------------------------------------------
 
-    def _run_batch(self, images, want_neighbors):
-        """Batcher thread body: one padded engine execution per flush.
-        Neighbors are computed for the whole micro-batch when ANY rider
-        wants them (the index scan is a small matmul next to the encoder
-        forward); /embed riders just drop the extra keys at scatter."""
+    def _run_batch(self, images, want_neighbors, modes=()):
+        """Batcher thread body: ONE padded engine execution per flush,
+        then one index query per requested tier on the same features
+        (the scans are small matmuls next to the encoder forward);
+        /embed riders just drop the extra keys at scatter. With an
+        approximate default tier, every `recall_sample_every`-th
+        neighbors flush also runs the exact oracle and records the
+        top-k overlap (`serve/recall_estimate`)."""
         if want_neighbors and self.index is not None:
-            emb, scores, idx, executed = self.engine.embed_and_query(
-                images, self.index, self.neighbors_k
+            requested = {self.neighbors_mode} | set(modes)
+            approx = next(
+                (m for m in (self.neighbors_mode, *sorted(requested))
+                 if m.startswith("ivf")),
+                None,
             )
-            return {"embedding": emb, "scores": scores, "indices": idx}, executed
+            sample_recall = False
+            if approx is not None and self.recall_sample_every > 0:
+                self._neighbor_flushes += 1
+                if self._neighbor_flushes % self.recall_sample_every == 0:
+                    sample_recall = True
+                    requested.add("exact")
+            with self._index_lock:
+                emb, per_mode, executed = self.engine.embed_and_query_modes(
+                    images, self.index, self.neighbors_k,
+                    modes=tuple(sorted(requested)), nprobe=self.nprobe,
+                )
+            if sample_recall:
+                _, exact_idx = per_mode["exact"]
+                _, approx_idx = per_mode[approx]
+                k = exact_idx.shape[1]
+                overlap = np.asarray([
+                    len(set(exact_idx[i]) & set(approx_idx[i]))
+                    for i in range(exact_idx.shape[0])
+                ])
+                self.metrics.record_recall(float(overlap.mean()) / k)
+            results = {"embedding": emb}
+            for m, (scores, idx) in per_mode.items():
+                results[f"scores:{m}"] = scores
+                results[f"indices:{m}"] = idx
+            return results, executed
         emb, executed = self.engine.embed(images)
         return {"embedding": emb}, executed
 
@@ -186,8 +311,20 @@ class ServeServer:
     def stats(self) -> dict:
         out = self.metrics.payload()
         out["serve/recompiles_after_warmup"] = self.engine.recompiles_after_warmup
+        # retrieval-tier gauges: which path answers /neighbors by default
+        # (schema: numbers only — nprobe null on exact tiers) and whether
+        # scoring runs quantized anywhere (index int8 mirror or engine PTQ)
+        out["serve/nprobe"] = (
+            (self.nprobe or (self.index._ivf or {}).get("nprobe"))
+            if self.index is not None and self.neighbors_mode.startswith("ivf")
+            else None
+        )
+        out["serve/int8"] = int(
+            self.neighbors_mode.endswith("_i8") or getattr(self.engine, "int8", False)
+        )
         if self.index is not None:
             out["serve/index_rows"] = self.index.count
+            out["serve/ingested_rows"] = self.ingested_rows
             out["serve/recompiles_after_warmup"] += self.index.recompiles_after_warmup
         return out
 
@@ -219,14 +356,26 @@ class ServeServer:
         self._write_metrics()
 
 
-def _query_k(query: str, default: int) -> int:
+def _query_param(query: str, name: str) -> str | None:
     for part in query.split("&"):
-        if part.startswith("k="):
-            try:
-                return max(1, min(int(part[2:]), default))
-            except ValueError:
-                break
+        if part.startswith(name + "="):
+            return part[len(name) + 1 :] or None
+    return None
+
+
+def _query_k(query: str, default: int) -> int:
+    val = _query_param(query, "k")
+    if val is not None:
+        try:
+            return max(1, min(int(val), default))
+        except ValueError:
+            pass
     return default
 
 
-__all__ = ["DEFAULT_NEIGHBORS_K", "ServeServer", "resolve_serve_port"]
+__all__ = [
+    "DEFAULT_NEIGHBORS_K",
+    "DEFAULT_RECALL_SAMPLE_EVERY",
+    "ServeServer",
+    "resolve_serve_port",
+]

@@ -38,6 +38,9 @@ import sys
 DEFAULT_LEDGER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "PERF_LEDGER.json")
 ACCEL_THRESHOLD = 0.10
 CPU_SMOKE_THRESHOLD = 0.50
+# recall@10 floor for the ANN series (CONTRIBUTING: the review gate) —
+# qps wins bought by recall losses fail the build
+ANN_RECALL_FLOOR = 0.95
 
 # bench-JSON fields copied into a ledger entry when present
 TRACKED_FIELDS = (
@@ -53,6 +56,7 @@ TRACKED_FIELDS = (
     "comms_total_bytes_per_step",
     "zero_ab",
     "serving",
+    "ann_ab",
     "legs",
 )
 
@@ -142,11 +146,12 @@ def _gate_series(
 
 def check(ledger_path: str, input_path: str, threshold: float | None = None) -> int:
     """0 = every series passes (or has no comparable leg); 1 = any
-    regression beyond threshold. Two gated series per record: the
-    training headline (`metric`/`value`) and — since the serving
-    subsystem — the serving headline (`serving.metric`/`serving.value`,
-    queries/s/chip at the fixed SLO), each against the most recent
-    ledger entry carrying the same metric name."""
+    regression beyond threshold. Three gated series per record: the
+    training headline (`metric`/`value`), the serving headline
+    (`serving.metric`/`serving.value`, queries/s/chip at the fixed
+    SLO), and the ANN headline (`ann_ab.metric`/`ann_ab.value`, IVF
+    queries/s — plus a hard recall@10 floor), each against the most
+    recent ledger entry carrying the same metric name."""
     ledger = load_ledger(ledger_path)
     rec = load_bench_record(input_path)
     rc = _gate_series(ledger, rec["metric"], rec.get("value"), threshold, lambda e: e)
@@ -159,6 +164,23 @@ def check(ledger_path: str, input_path: str, threshold: float | None = None) -> 
             threshold,
             lambda e: e.get("serving"),
         )
+    # third gated series since the IVF tier: approximate-NN queries/s
+    # (the sub-linear retrieval headline) — same most-recent-comparable
+    # rule; additionally a recall@10 FLOOR (an ANN index that got fast
+    # by dropping recall is a regression, not a win)
+    ann = rec.get("ann_ab")
+    if ann and ann.get("metric"):
+        rc |= _gate_series(
+            ledger, ann["metric"], ann.get("value"), threshold,
+            lambda e: e.get("ann_ab"),
+        )
+        recall = ann.get("recall_at_10")
+        if recall is not None and recall < ANN_RECALL_FLOOR:
+            print(
+                f"perf gate [FAIL] {ann['metric']}: recall@10 {recall:.3f} "
+                f"below the {ANN_RECALL_FLOOR} floor"
+            )
+            rc |= 1
     # informational deltas for the secondary series (never gating —
     # they gate the day they prove stable enough)
     baseline = None

@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Stream a LIVE training run's dictionary into a serving replica.
+
+The training queue and the serving index share their FIFO kernel
+(serve/index.py:fifo_write) — this script closes the remaining gap in
+the ROADMAP "streaming index updates from a live run" item: it tails a
+training run's checkpoint directory and FIFO-ingests the freshly
+enqueued queue rows into a RUNNING replica over the server's `/ingest`
+endpoint, so a long-lived serving process tracks the dictionary the
+trainer is still building without a restart or a bulk reload.
+
+    python scripts/serve_ingest.py --ckpt-dir /run/workdir \
+        --server http://127.0.0.1:8000 [--poll-s 10] [--once]
+
+Per new checkpoint step: restore the queue + write head, diff against
+the last seen head (the freshly enqueued region is `[old_ptr, new_ptr)`
+circular; the FIRST sighting ingests the full queue oldest-first so the
+replica starts aligned), POST the block as raw f32 rows. The replica's
+IVF cell membership and int8 mirror follow each ingest incrementally
+(serve/server.py `/ingest` → `EmbeddingIndex.add`), and
+`serve/ingested_rows` / `serve/index_rows` advance in its metric flush
+— which is exactly what the smoke asserts.
+
+Assumes fewer than K rows are enqueued between polled checkpoints (a
+full-queue turnover with an identical head is indistinguishable from
+no-op; shorten --poll-s if the trainer outruns it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+DEFAULT_BLOCK = 512  # rows per POST: bounds request size and replica compiles
+
+
+def fresh_rows(queue: np.ndarray, old_ptr, new_ptr: int) -> np.ndarray:
+    """The block the trainer enqueued since the last sighting, in FIFO
+    (oldest-first) order. `old_ptr=None` = first sighting: the whole
+    valid queue, oldest-first from the write head."""
+    if old_ptr is None:
+        return np.concatenate([queue[new_ptr:], queue[:new_ptr]])
+    old_ptr = int(old_ptr)
+    if new_ptr == old_ptr:
+        return queue[:0]
+    if new_ptr > old_ptr:
+        return queue[old_ptr:new_ptr]
+    return np.concatenate([queue[old_ptr:], queue[:new_ptr]])
+
+
+def post_rows(server: str, rows: np.ndarray, block: int = DEFAULT_BLOCK) -> int:
+    """POST `rows` to the replica's /ingest in bounded blocks; returns
+    the replica's reported index row count after the last block."""
+    index_rows = -1
+    for lo in range(0, rows.shape[0], block):
+        chunk = np.ascontiguousarray(rows[lo : lo + block], np.float32)
+        req = urllib.request.Request(
+            server.rstrip("/") + "/ingest",
+            data=chunk.tobytes(),
+            headers={"X-Rows-Shape": f"{chunk.shape[0]},{chunk.shape[1]}"},
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            index_rows = json.loads(r.read())["index_rows"]
+    return index_rows
+
+
+def poll_once(ckpt_dir: str, server: str, seen: dict, block: int = DEFAULT_BLOCK) -> int:
+    """One tail step: ingest anything new; returns rows ingested.
+    `seen` carries {'step', 'ptr'} across polls."""
+    from moco_tpu.lincls import restore_pretrain_state
+    from moco_tpu.utils.checkpoint import CheckpointManager
+
+    step = CheckpointManager(ckpt_dir).latest_step()
+    if step is None or step == seen.get("step"):
+        return 0
+    state, _ = restore_pretrain_state(ckpt_dir)
+    queue = np.asarray(state.queue, np.float32)
+    new_ptr = int(state.queue_ptr)
+    rows = fresh_rows(queue, seen.get("ptr"), new_ptr)
+    if rows.shape[0]:
+        index_rows = post_rows(server, rows, block)
+        print(
+            f"step {step}: ingested {rows.shape[0]} fresh rows "
+            f"(replica index_rows={index_rows})",
+            flush=True,
+        )
+    seen["step"], seen["ptr"] = step, new_ptr
+    return int(rows.shape[0])
+
+
+def main() -> int:
+    from moco_tpu.utils.platform import pin_platform_from_env
+
+    pin_platform_from_env()
+    ap = argparse.ArgumentParser(description="tail a training checkpoint dir into a serving replica")
+    ap.add_argument("--ckpt-dir", required=True, help="the training run's workdir")
+    ap.add_argument("--server", required=True, help="replica base URL, e.g. http://127.0.0.1:8000")
+    ap.add_argument("--poll-s", type=float, default=10.0)
+    ap.add_argument("--block", type=int, default=DEFAULT_BLOCK, help="rows per /ingest POST")
+    ap.add_argument("--once", action="store_true", help="one poll, then exit (smoke/test mode)")
+    args = ap.parse_args()
+    seen: dict = {}
+    while True:
+        poll_once(args.ckpt_dir, args.server, seen, args.block)
+        if args.once:
+            return 0
+        time.sleep(args.poll_s)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

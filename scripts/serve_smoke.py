@@ -18,11 +18,23 @@ The story (the ISSUE-8 acceptance bullet, executable):
    neighbor indices inside the queue), ZERO recompiles after warmup
    across all request sizes, p99 latency ≤ the smoke SLO, batch
    occupancy in (0, 1], multiple buckets exercised, and the flushed
-   `serve/*` metrics lines schema-strict.
+   `serve/*` metrics lines schema-strict;
+5. the STREAMING-INGEST leg (ISSUE 9): a second checkpoint lands in the
+   same workdir with fresh queue rows, `scripts/serve_ingest.py` tails
+   it once into the still-running replica over `/ingest`, and the
+   serving count (`serve/ingested_rows`, and retrievability of the new
+   rows) advances without a restart;
+6. the IVF leg (ISSUE 9): a second server boots with
+   `neighbors_mode="ivf"` over a clustered dictionary (k-means cells,
+   `nprobe` of `nlist` probed per query, recall sampled on EVERY
+   neighbors flush) — asserts ZERO recompiles after warmup on the IVF
+   path, the online `serve/recall_estimate` at or above the recall
+   floor, p99 ≤ the smoke SLO, and the `serve/nprobe`/`serve/int8`
+   gauges schema-strict.
 
 CI runs this in the tier-1 job and uploads the workdir (metrics.jsonl +
 serve_smoke.json summary) as an artifact. Wall cost: one tiny-model
-AOT warmup + ~200 small requests, well under a minute on a CPU host.
+AOT warmup + ~260 small requests, well under a minute on a CPU host.
 """
 
 from __future__ import annotations
@@ -56,6 +68,15 @@ REQUEST_SIZES = (1, 2, 4, 8, 16)
 # SAME ResNet-18 on this host), which would turn the smoke into a
 # 10-minute run for no extra coverage
 IMAGE_SIZE = 32
+# IVF leg: a clustered dictionary (nlist cells), nprobe of them probed
+# per query, recall sampled on every neighbors flush and gated at the
+# floor. The smoke proves the WIRING + freeze discipline; the bench
+# ann_ab leg owns the speed claim at real dictionary sizes.
+IVF_REQUESTS = 60
+IVF_DICT_ROWS = 256
+IVF_NLIST = 16
+IVF_NPROBE = 12
+RECALL_FLOOR = float(os.environ.get("SERVE_SMOKE_RECALL_FLOOR", 0.95))
 
 
 def make_toy_checkpoint(workdir: str):
@@ -184,8 +205,19 @@ def run_smoke(workdir: str) -> dict:
         t.start()
     for t in threads:
         t.join(timeout=300)
+
+    # -- leg 5: streaming ingest from a "live" training run -------------
+    # A fresh checkpoint (same dir, fresh queue rows at the write head)
+    # appears while the replica serves; serve_ingest tails it once over
+    # /ingest and the serving count advances — no restart, no reload.
+    ingest_summary = _ingest_leg(ckpt_dir, server, index)
+
     stats_out = server.stats()
     server.close()
+
+    # -- leg 6: the IVF retrieval tier ----------------------------------
+    ivf_summary = _ivf_leg(engine, sink, canned)
+
     sink.close()
     summary = {
         "requests_sent": per_client * NUM_CLIENTS,
@@ -194,10 +226,124 @@ def run_smoke(workdir: str) -> dict:
         "stats": stats_out,
         "donation_audit": {str(k): v for k, v in engine.donation_audit().items()},
         "buckets": list(engine.buckets),
+        "ingest": ingest_summary,
+        "ivf": ivf_summary,
     }
     with open(os.path.join(workdir, "serve_smoke.json"), "w") as f:
         json.dump(summary, f, indent=2)
     return summary
+
+
+def _ingest_leg(ckpt_dir: str, server, index) -> dict:
+    """Write checkpoint step 1 with fresh queue rows, tail it once with
+    scripts/serve_ingest.py machinery, return what advanced."""
+    import numpy as np
+
+    from moco_tpu.lincls import restore_pretrain_state
+    from moco_tpu.utils.checkpoint import CheckpointManager
+    from moco_tpu.utils.config import config_to_dict
+
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "serve_ingest", os.path.join(os.path.dirname(os.path.abspath(__file__)), "serve_ingest.py")
+    )
+    ingest = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ingest)
+
+    state, config = restore_pretrain_state(ckpt_dir)
+    fresh_n = 16
+    rng = np.random.default_rng(42)
+    fresh = rng.normal(size=(fresh_n, state.queue.shape[1])).astype(np.float32)
+    fresh /= np.linalg.norm(fresh, axis=1, keepdims=True)
+    queue = np.asarray(state.queue).copy()
+    queue[:fresh_n] = fresh
+    import jax.numpy as jnp
+
+    state = state.replace(queue=jnp.asarray(queue), queue_ptr=jnp.int32(fresh_n))
+    mgr = CheckpointManager(ckpt_dir)
+    mgr.save(1, state, extra={"epoch": 0, "config": config_to_dict(config), "num_data": 1})
+    mgr.close()
+
+    before = server.ingested_rows
+    # seen pre-seeded at (step 0, head 0): only the fresh region ingests
+    seen = {"step": 0, "ptr": 0}
+    ingested = ingest.poll_once(ckpt_dir, f"http://127.0.0.1:{server.port}", seen)
+    # the freshly ingested rows must be retrievable at the write head
+    # (k=5 / bucket 1 is a prepared shape on the frozen index)
+    scores, idx = index.query(fresh[:1], 5)
+    return {
+        "ingested": int(ingested),
+        "counter_before": int(before),
+        "counter_after": int(server.ingested_rows),
+        "head_hit": bool(idx[0, 0] == 0 and scores[0, 0] > 0.999),
+    }
+
+
+def _ivf_leg(engine, sink, canned) -> dict:
+    """Second server, approximate tier: clustered dictionary, IVF cells,
+    per-flush recall sampling against the exact oracle."""
+    import numpy as np
+
+    from moco_tpu.serve.index import EmbeddingIndex
+    from moco_tpu.serve.server import ServeServer
+
+    rng = np.random.default_rng(5)
+    dim = engine.num_features or 16
+    per = IVF_DICT_ROWS // IVF_NLIST
+    centers = rng.normal(size=(IVF_NLIST, dim)).astype(np.float32)
+    rows = np.repeat(centers, per, axis=0) + 0.2 * rng.normal(
+        size=(IVF_DICT_ROWS, dim)
+    ).astype(np.float32)
+    rows /= np.linalg.norm(rows, axis=1, keepdims=True)
+    index = EmbeddingIndex(IVF_DICT_ROWS, dim)
+    index.snapshot(rows)
+    index.train_ivf(nlist=IVF_NLIST, nprobe=IVF_NPROBE)
+    server = ServeServer(
+        engine,
+        index=index,
+        port=0,
+        slo_ms=SERVER_SLO_MS,
+        neighbors_k=5,
+        neighbors_mode="ivf",
+        nprobe=IVF_NPROBE,
+        recall_sample_every=1,  # sample the oracle on EVERY neighbors flush
+        sink=sink,
+        metrics_flush_s=0.5,
+    )
+    base = f"http://127.0.0.1:{server.port}"
+    failures: list[str] = []
+    try:
+        for j in range(IVF_REQUESTS):
+            n = int(rng.choice(REQUEST_SIZES))
+            imgs = canned[n]
+            # 2/3 of requests name the tier explicitly, the rest ride
+            # the server default — both must resolve to ivf
+            path = "/neighbors?k=5&mode=ivf" if j % 3 else "/neighbors?k=5"
+            req = urllib.request.Request(
+                base + path,
+                data=imgs.tobytes(),
+                headers={"X-Image-Shape": ",".join(map(str, imgs.shape))},
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=60) as r:
+                    out = json.loads(r.read())
+                idx = np.asarray(out["indices"])
+                if out.get("mode") != "ivf" or idx.shape != (n, 5) or (
+                    idx >= IVF_DICT_ROWS
+                ).any():
+                    failures.append(f"ivf req {j}: malformed {out.get('mode')}")
+            except Exception as e:
+                failures.append(f"ivf req {j}: {e!r}")
+        stats = server.stats()
+    finally:
+        server.close()
+    return {
+        "failures": failures,
+        "stats": stats,
+        "recall_floor": RECALL_FLOOR,
+        "ivf_stats": index.ivf_stats(),
+    }
 
 
 def assert_serve_surface(workdir: str, summary: dict) -> None:
@@ -216,6 +362,25 @@ def assert_serve_surface(workdir: str, summary: dict) -> None:
     buckets_hit = [k for k in stats if k.startswith("serve/bucket_")]
     assert len(buckets_hit) >= 2, f"mixed sizes should exercise >1 bucket: {stats}"
     assert stats["serve/index_rows"] == 64, stats
+    # leg 5: streaming ingest advanced the serving count, no restart
+    ingest = summary["ingest"]
+    assert ingest["ingested"] > 0, ingest
+    assert ingest["counter_after"] == ingest["counter_before"] + ingest["ingested"]
+    assert stats["serve/ingested_rows"] == ingest["counter_after"], stats
+    assert ingest["head_hit"], "freshly ingested rows not retrievable at the head"
+    # leg 6: the IVF path — zero recompiles after warmup, the online
+    # recall estimate at/above the floor, p99 under the smoke SLO
+    ivf = summary["ivf"]
+    assert not ivf["failures"], f"ivf request failures: {ivf['failures'][:5]}"
+    istats = ivf["stats"]
+    assert istats["serve/recompiles_after_warmup"] == 0, istats
+    assert istats["serve/recall_estimate"] is not None, istats
+    assert istats["serve/recall_estimate"] >= RECALL_FLOOR, (
+        f"online recall {istats['serve/recall_estimate']} below the "
+        f"{RECALL_FLOOR} floor (nprobe={istats.get('serve/nprobe')})"
+    )
+    assert istats["serve/p99_ms"] is not None and istats["serve/p99_ms"] <= SMOKE_SLO_MS
+    assert istats["serve/nprobe"] == IVF_NPROBE and istats["serve/int8"] == 0, istats
     # metrics flushed through the sink are schema-strict
     metrics_path = os.path.join(workdir, "metrics.jsonl")
     assert os.path.exists(metrics_path), "server flushed no metrics.jsonl"
@@ -223,6 +388,9 @@ def assert_serve_surface(workdir: str, summary: dict) -> None:
     assert not errors, f"schema violations: {errors[:5]}"
     lines = schema.read_metrics(metrics_path)
     assert any("serve/qps" in r for r in lines), "no serve/* line reached the sink"
+    assert any(
+        r.get("serve/recall_estimate") is not None for r in lines
+    ), "no recall estimate reached the sink"
 
 
 def main() -> int:
@@ -237,11 +405,18 @@ def main() -> int:
     summary = run_smoke(workdir)
     assert_serve_surface(workdir, summary)
     s = summary["stats"]
+    iv = summary["ivf"]["stats"]
     print(
         f"serve smoke OK: {s['serve/requests']} requests, "
         f"p50={s['serve/p50_ms']:.1f}ms p99={s['serve/p99_ms']:.1f}ms "
         f"qps={s['serve/qps']:.1f} occupancy={s['serve/occupancy']:.3f} "
-        f"recompiles_after_warmup={s['serve/recompiles_after_warmup']} — "
+        f"recompiles_after_warmup={s['serve/recompiles_after_warmup']} | "
+        f"ingested={summary['ingest']['ingested']} | "
+        f"ivf: {iv['serve/requests']} requests "
+        f"recall={iv['serve/recall_estimate']:.3f} "
+        f"nprobe={iv['serve/nprobe']}/{IVF_NLIST} "
+        f"p99={iv['serve/p99_ms']:.1f}ms "
+        f"recompiles={iv['serve/recompiles_after_warmup']} — "
         f"artifacts in {workdir}"
     )
     return 0

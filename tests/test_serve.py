@@ -237,10 +237,26 @@ def test_index_from_train_queue_roundtrip():
     np.testing.assert_allclose(scores[:, 0], 1.0, rtol=1e-5)
 
 
-def test_index_add_requires_divisible_block():
+def test_index_add_wrap_splits_at_capacity_boundary():
+    """Serving ingest takes arbitrary block sizes: a block crossing the
+    capacity boundary splits into two no-wrap writes (training keeps its
+    K % N == 0 invariant and never wraps)."""
     idx = EmbeddingIndex(8, 4)
-    with pytest.raises(ValueError, match="no-wrap"):
-        idx.add(np.zeros((3, 4), np.float32))
+    blocks = [
+        np.asarray(l2_normalize(jnp.full((3, 4), float(i + 1), jnp.float32)))
+        for i in range(3)
+    ]
+    for b in blocks:
+        idx.add(b)
+    # 9 rows through capacity 8: head wrapped to 0 and row 0 holds the
+    # last row of block 2; rows 3..5 hold block 1, 6..7 block 2's head
+    rows = np.asarray(idx.rows)
+    np.testing.assert_allclose(rows[0], blocks[2][2])
+    np.testing.assert_allclose(rows[3:6], blocks[1])
+    np.testing.assert_allclose(rows[6:8], blocks[2][:2])
+    assert idx.count == 8 and idx._ptr == 1
+    with pytest.raises(ValueError, match="exceeds capacity"):
+        idx.add(np.zeros((9, 4), np.float32))
 
 
 # -- engine + server (shared fixture: AOT compiles are the slow part) ---
