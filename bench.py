@@ -515,16 +515,15 @@ def main() -> None:
                 index.prepare(eng.buckets, k=5)
                 index.freeze()
 
-            def run_batch(images, want_neighbors):
+            def run_batch(images, want_neighbors, *, stages=None):
                 if want_neighbors and index is not None:
-                    emb, scores, nidx, executed = eng.embed_and_query(images, index, 5)
+                    emb, scores, nidx, executed = eng.embed_and_query(
+                        images, index, 5, stages=stages
+                    )
                     return {"embedding": emb, "scores": scores, "indices": nidx}, executed
-                emb, executed = eng.embed(images)
+                emb, executed = eng.embed(images, stages=stages)
                 return {"embedding": emb}, executed
 
-            batcher = ContinuousBatcher(
-                run_batch, max_batch=eng.buckets[-1], slo_ms=slo_ms
-            )
             sizes = tuple(
                 s for s in (1, 2, 4, 8, 16, 32) if s <= eng.buckets[-1]
             )
@@ -532,52 +531,68 @@ def main() -> None:
                 n: np.random.default_rng(n).integers(0, 255, (n, img, img, 3), np.uint8)
                 for n in sizes
             }
-            measuring = threading.Event()
-            stop_clients = threading.Event()
-            counts = [0] * 8
-
-            def client(ci: int) -> None:
-                crng = np.random.default_rng(100 + ci)
-                while not stop_clients.is_set():
-                    n = int(crng.choice(sizes))
-                    try:
-                        fut = batcher.submit(
-                            canned[n], want_neighbors=index is not None
-                        )
-                        fut.result(timeout=30.0)
-                    except Exception:
-                        return
-                    if measuring.is_set():
-                        counts[ci] += 1
-
-            clients = [
-                threading.Thread(target=client, args=(i,), daemon=True)
-                for i in range(len(counts))
-            ]
-            for c in clients:
-                c.start()
             warm_s = float(os.environ.get("BENCH_SERVE_WARM_S", 1.0 if on_tpu else 3.0))
             measure_s = float(
                 os.environ.get("BENCH_SERVE_MEASURE_S", 3.0 if on_tpu else 8.0)
             )
-            time.sleep(warm_s)
-            measuring.set()
-            t0s = time.perf_counter()
-            time.sleep(measure_s)
-            measuring.clear()
-            dts = time.perf_counter() - t0s
-            stop_clients.set()
-            batcher.close()
-            for c in clients:
-                c.join(timeout=5.0)
-            payload = batcher.metrics.payload()
-            completed = sum(counts)
-            if completed == 0:
-                raise RuntimeError(
-                    f"no request completed inside the {measure_s}s measure "
-                    "window — raise BENCH_SERVE_MEASURE_S on very slow hosts"
+
+            def measure(reqtrace: bool):
+                """One closed-loop pass: fresh batcher + clients over the
+                shared warm engine; returns (qps/chip, final payload)."""
+                batcher = ContinuousBatcher(
+                    run_batch, max_batch=eng.buckets[-1], slo_ms=slo_ms,
+                    reqtrace=reqtrace,
                 )
-            qps_chip = completed / dts / n_dev
+                measuring = threading.Event()
+                stop_clients = threading.Event()
+                counts = [0] * 8
+
+                def client(ci: int) -> None:
+                    crng = np.random.default_rng(100 + ci)
+                    while not stop_clients.is_set():
+                        n = int(crng.choice(sizes))
+                        try:
+                            fut = batcher.submit(
+                                canned[n], want_neighbors=index is not None
+                            )
+                            fut.result(timeout=30.0)
+                        except Exception:
+                            return
+                        if measuring.is_set():
+                            counts[ci] += 1
+
+                clients = [
+                    threading.Thread(target=client, args=(i,), daemon=True)
+                    for i in range(len(counts))
+                ]
+                for c in clients:
+                    c.start()
+                time.sleep(warm_s)
+                measuring.set()
+                t0s = time.perf_counter()
+                time.sleep(measure_s)
+                measuring.clear()
+                dts = time.perf_counter() - t0s
+                stop_clients.set()
+                batcher.close()
+                for c in clients:
+                    c.join(timeout=5.0)
+                payload = batcher.metrics.payload()
+                completed = sum(counts)
+                if completed == 0:
+                    raise RuntimeError(
+                        f"no request completed inside the {measure_s}s measure "
+                        "window — raise BENCH_SERVE_MEASURE_S on very slow hosts"
+                    )
+                return completed / dts / n_dev, payload
+
+            # A/B: the tracked headline stays the tracing-OFF pass (the
+            # r06+ series must remain comparable); the tracing-ON pass
+            # measures the request-trace overhead the ISSUE-10 acceptance
+            # caps (perf_ledger gates trace_overhead_pct)
+            qps_chip, payload = measure(reqtrace=False)
+            qps_traced, payload_traced = measure(reqtrace=True)
+            trace_overhead_pct = (qps_chip - qps_traced) / qps_chip * 100.0
             recompiles = eng.recompiles_after_warmup + (
                 index.recompiles_after_warmup if index is not None else 0
             )
@@ -608,13 +623,26 @@ def main() -> None:
                     if k.startswith("serve/bucket_")
                 },
                 "neighbors": index is not None,
+                # request-tracing A/B (ISSUE 10): qps with per-request
+                # waterfalls ON, the measured overhead (gated by
+                # perf_ledger.py check), and the traced pass's mean
+                # stage split
+                "qps_traced": round(qps_traced, 2),
+                "trace_overhead_pct": round(trace_overhead_pct, 2),
+                "trace_stage_ms": {
+                    k[len("serve/trace_"):-len("_ms")]: v
+                    for k, v in payload_traced.items()
+                    if k.startswith("serve/trace_") and k.endswith("_ms")
+                },
             }
             legs["serving"]["ran"] = True
             print(
                 f"serving: {qps_chip:.1f} queries/s/chip @ SLO {slo_ms}ms "
                 f"(p50={payload['serve/p50_ms']}ms p99={payload['serve/p99_ms']}ms "
                 f"occupancy={payload['serve/occupancy']} "
-                f"violations={serving['slo_violation_rate']})",
+                f"violations={serving['slo_violation_rate']} "
+                f"traced={qps_traced:.1f} q/s "
+                f"overhead={trace_overhead_pct:+.1f}%)",
                 file=sys.stderr,
             )
         except Exception as e:

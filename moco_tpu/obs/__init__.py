@@ -12,6 +12,9 @@ One cross-cutting subsystem, five parts (see each module's docstring):
 - `fleet`     cross-host stats aggregation + out-of-band heartbeats
 - `comms`     named collective sites + analytic bytes-moved counters
 - `alerts`    declarative in-stream alert rules -> alerts.jsonl
+- `reqtrace`  request-scoped stage-stamped traces for the serving stack
+- `slo`       multi-window SLO burn-rate accounting over `slo_ms`
+- `flight`    tail-latency flight recorder (bounded ring + atomic dump)
 
 `span`/`instant` are re-exported eagerly because they are the
 high-traffic wiring surface (`from moco_tpu import obs; obs.span(...)`)
@@ -51,6 +54,15 @@ _LAZY = {
     "AlertEngine": "alerts",
     "FatalAlertError": "alerts",
     "parse_rules": "alerts",
+    # request-scoped serving observability (all stdlib-only, lazy for
+    # symmetry with the other non-eager modules)
+    "RequestTrace": "reqtrace",
+    "RequestIdAllocator": "reqtrace",
+    "emit_request_spans": "reqtrace",
+    "SLOBurnTracker": "slo",
+    "serve_alert_spec": "slo",
+    "FlightRecorder": "flight",
+    "read_flight_dumps": "flight",
 }
 
 

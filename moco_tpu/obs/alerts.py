@@ -170,9 +170,16 @@ class AlertEngine:
         rules: list[AlertRule],
         workdir: Optional[str] = None,
         process_index: int = 0,
+        on_fire=None,
     ):
         self.rules = list(rules)
         self.process_index = int(process_index)
+        # per-alert callback, invoked (after the jsonl write) with each
+        # fired alert dict — the serving stack hooks its flight-recorder
+        # dump here so the capture happens AT the firing edge, not a
+        # flush later. Exceptions are swallowed: a broken hook must not
+        # take alerting (or the run) down.
+        self.on_fire = on_fire
         self.workdir = workdir
         self.path = os.path.join(workdir, "alerts.jsonl") if workdir else None
         self._f = None
@@ -204,6 +211,12 @@ class AlertEngine:
                 fired.append(alert)
         if fired:
             self._write(fired)
+            if self.on_fire is not None:
+                for alert in fired:
+                    try:
+                        self.on_fire(alert)
+                    except Exception as e:
+                        print(f"WARNING: alert on_fire hook failed: {e!r}", flush=True)
         return fired
 
     def _cooldown_ok(self, rule: AlertRule) -> bool:

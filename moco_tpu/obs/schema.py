@@ -26,6 +26,14 @@ lines: `straggler_skew`/`fleet_hosts` plus the
 `fleet/<field>_{min,mean,max,argmax}` family on process 0, and the
 analytic `comms/<site>` bytes-per-step counters on every process.
 
+Serving lines (serve/server.py's flusher) carry the `serve/*` family,
+including the request-scoped surface (PR 10): `serve/trace_<stage>_ms`
+stage-waterfall means, `serve/burn_rate_<w>s` SLO burn rates,
+`serve/latency_hist` (a structured cumulative-histogram payload), and
+the `serve/p99_exemplar` request id — the one STRING inside the
+numeric family, which is why explicit field validators take precedence
+over the prefix families in `validate_line`.
+
 Numbers are finite or null — NaN/Inf literals are rejected at parse
 time (`loads_strict`), matching the writer's scrubbing.
 
@@ -66,6 +74,34 @@ def _num_list(v: Any) -> bool:
 def _counter_map(v: Any) -> bool:
     return isinstance(v, dict) and all(
         isinstance(k, str) and _int_like(n) for k, n in v.items()
+    )
+
+
+def _nonneg_or_null(v: Any) -> bool:
+    return v is None or (_num(v) and v >= 0)
+
+
+def _str_or_null(v: Any) -> bool:
+    return v is None or isinstance(v, str)
+
+
+def _latency_hist(v: Any) -> bool:
+    """The cumulative-histogram payload the Prometheus sink renders as
+    `<name>_bucket{le=...}`: finite ascending bucket bounds (ms), one
+    count per bucket plus the +Inf overflow slot, and the sum/count
+    pair. Counts are PER-BUCKET here; the sink cumulates at render."""
+    if not isinstance(v, dict):
+        return False
+    le, counts = v.get("le"), v.get("counts")
+    return (
+        isinstance(le, list)
+        and all(_num(x) for x in le)
+        and le == sorted(le)
+        and isinstance(counts, list)
+        and len(counts) == len(le) + 1
+        and all(_int_like(c) and c >= 0 for c in counts)
+        and _num(v.get("sum"))
+        and _int_like(v.get("count"))
     )
 
 
@@ -141,6 +177,18 @@ FIELD_VALIDATORS = {
     "serve/nprobe": lambda v: v is None or (_int_like(v) and v >= 1),
     "serve/int8": lambda v: v in (0, 1),
     "serve/ingested_rows": _int_like,
+    # request-scoped serving observability (obs/reqtrace.py, obs/slo.py,
+    # obs/flight.py — PR 10): the latency histogram the Prometheus sink
+    # exposes with real cumulative buckets, the p99 exemplar linking the
+    # latency gauges to the offending request id (a STRING — exempted
+    # from the numeric serve/ prefix family below), its latency, the
+    # declared SLO objective, and the measured tracing overhead the
+    # bench serving leg reports
+    "serve/latency_hist": _latency_hist,
+    "serve/p99_exemplar": _str_or_null,
+    "serve/p99_exemplar_ms": _nonneg_or_null,
+    "serve/slo_objective": lambda v: _num(v) and 0.0 < v < 1.0,
+    "serve/trace_overhead_pct": _num_or_null,
     # fleet observability (obs/fleet.py; process-0 lines only)
     "fleet_hosts": _int_like,
     "straggler_skew": _num_or_null,
@@ -163,6 +211,12 @@ PREFIX_VALIDATORS = {
     "comms/": _num,
     "alert/": _num,
     "serve/": _num_or_null,
+    # request-trace stage means (ms) and the multi-window SLO burn-rate
+    # family — tighter than the generic serve/ family (burn/stage time
+    # can be null while a window is empty, never negative). Longest
+    # matching prefix wins (see validate_line), so these shadow serve/.
+    "serve/trace_": _nonneg_or_null,
+    "serve/burn_rate_": _nonneg_or_null,
 }
 
 
@@ -198,10 +252,19 @@ def validate_line(rec: dict) -> list[str]:
         if k in rec and not check(rec[k]):
             errors.append(f"field {k!r} has invalid value {rec[k]!r}")
     # prefix families (ema_drift/<group>, fleet/<field>_<stat>,
-    # comms/<site>, alert/<rule>) share per-family validators
+    # comms/<site>, alert/<rule>, serve/...) share per-family
+    # validators. An explicit FIELD_VALIDATORS entry wins outright
+    # (serve/p99_exemplar is a string inside the numeric serve/
+    # family); otherwise the LONGEST matching prefix applies, so
+    # serve/burn_rate_* gets its non-negative check rather than the
+    # looser serve/ one.
     for k, v in rec.items():
-        for prefix, check in PREFIX_VALIDATORS.items():
-            if k.startswith(prefix) and not check(v):
+        if k in FIELD_VALIDATORS:
+            continue
+        matches = [p for p in PREFIX_VALIDATORS if k.startswith(p)]
+        if matches:
+            check = PREFIX_VALIDATORS[max(matches, key=len)]
+            if not check(v):
                 errors.append(f"field {k!r} has invalid value {v!r}")
     return errors
 

@@ -220,6 +220,49 @@ def prom_name(key: str, prefix: str = "moco") -> str:
     return f"{prefix}_{safe}"
 
 
+def _is_histogram(v) -> bool:
+    """Payload values shaped like obs/schema.py's latency-histogram
+    contract render as Prometheus histograms instead of gauges."""
+    return (
+        isinstance(v, dict)
+        and isinstance(v.get("le"), list)
+        and isinstance(v.get("counts"), list)
+        and len(v["counts"]) == len(v["le"]) + 1
+        and "sum" in v
+        and "count" in v
+    )
+
+
+def _render_histogram(name: str, hist: dict) -> list[str]:
+    """Cumulative `_bucket{le=...}` + `_sum`/`_count` lines for one
+    histogram payload. The per-bucket counts cumulate here (Prometheus
+    histogram semantics). When the payload carries an exemplar
+    ({"request_id", "latency_ms"} — the p99 offender's request id), it
+    is attached OpenMetrics-style after the bucket it falls in; text
+    -format-0.0.4 scrapers treat the `# {...}` tail as a comment, so
+    the line degrades gracefully."""
+    lines = [f"# TYPE {name} histogram"]
+    exemplar = hist.get("exemplar") or {}
+    ex_ms = exemplar.get("latency_ms")
+    ex_id = exemplar.get("request_id")
+    cum = 0
+    for le, count in zip(hist["le"], hist["counts"]):
+        cum += count
+        line = f'{name}_bucket{{le="{le:g}"}} {cum}'
+        if ex_id is not None and ex_ms is not None and ex_ms <= le:
+            line += f' # {{request_id="{ex_id}"}} {ex_ms:g}'
+            ex_id = ex_ms = None  # exemplar rides exactly one bucket
+        lines.append(line)
+    cum += hist["counts"][-1]
+    line = f'{name}_bucket{{le="+Inf"}} {cum}'
+    if ex_id is not None and ex_ms is not None:
+        line += f' # {{request_id="{ex_id}"}} {ex_ms:g}'
+    lines.append(line)
+    lines.append(f"{name}_sum {hist['sum']}")
+    lines.append(f"{name}_count {hist['count']}")
+    return lines
+
+
 class PrometheusSink(Sink):
     """Last-value gauges + event counters behind an in-process HTTP
     `/metrics` endpoint (Prometheus text exposition format 0.0.4), for
@@ -232,6 +275,12 @@ class PrometheusSink(Sink):
         self._lock = threading.Lock()
         self._gauges: dict[str, float] = {}
         self._events: dict[str, int] = {}
+        # histogram-shaped payload values ({"le", "counts", "sum",
+        # "count"[, "exemplar"]} — obs/schema.py `serve/latency_hist`)
+        # render as REAL cumulative `_bucket{le=...}` series, so
+        # external SLO tooling can compute its own quantiles instead of
+        # trusting the precomputed p50/p99 gauges
+        self._hists: dict[str, dict] = {}
         self._prefix = prefix
         self.host = host
         sink = self
@@ -265,6 +314,12 @@ class PrometheusSink(Sink):
             if "event" in rec:
                 self._events[str(rec["event"])] = self._events.get(str(rec["event"]), 0) + 1
             for k, v in rec.items():
+                if _is_histogram(v):
+                    # "serve/latency_hist" -> moco_serve_latency_ms (the
+                    # bounds are milliseconds; the suffix says so)
+                    base = k[: -len("_hist")] if k.endswith("_hist") else k
+                    self._hists[prom_name(base + "_ms", self._prefix)] = v
+                    continue
                 if isinstance(v, bool) or not isinstance(v, (int, float)):
                     continue
                 self._gauges[prom_name(k, self._prefix)] = v
@@ -275,6 +330,8 @@ class PrometheusSink(Sink):
             for name in sorted(self._gauges):
                 lines.append(f"# TYPE {name} gauge")
                 lines.append(f"{name} {self._gauges[name]}")
+            for name in sorted(self._hists):
+                lines.extend(_render_histogram(name, self._hists[name]))
             total = prom_name("events_total", self._prefix)
             if self._events:
                 lines.append(f"# TYPE {total} counter")

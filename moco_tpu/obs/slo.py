@@ -1,0 +1,147 @@
+"""SLO burn-rate accounting for the serving stack.
+
+A raw `serve/slo_violations` counter can't drive paging: a single slow
+request in a week and a sustained 5% violation rate both increment it.
+The SRE-standard signal is the **burn rate** — how fast the service is
+spending its error budget:
+
+    burn = (violating fraction over a window) / (1 - objective)
+
+burn == 1 means the budget exactly runs out at the end of the SLO
+period; 14.4 means a 30-day budget is gone in 2 days. Multi-window
+evaluation (a fast window to catch cliffs, a slow one to catch creep)
+is what the default alert rules threshold on.
+
+:class:`SLOBurnTracker` keeps per-second good/bad buckets over the
+longest window (bounded memory, O(1) record from the batcher thread)
+and reports `serve/burn_rate_<w>s` gauges the obs schema validates,
+the Prometheus sink exposes, and the existing `obs/alerts.py`
+threshold rules fire on — no new rule kind needed.
+:func:`serve_alert_spec` builds the serving default rule set in the
+alerts grammar; the server parses it with `alerts.parse_rules` and
+dumps the flight recorder when a rule fires.
+
+Stdlib-only, like every obs module the report tooling imports.
+"""
+
+from __future__ import annotations
+
+import time
+import threading
+from collections import deque
+from typing import Optional, Sequence
+
+# (fast, slow) windows, seconds. Burn thresholds below are the classic
+# multiwindow pair scaled to these: sustained burn > the threshold on
+# the fast window pages quickly; the slow window catches slow leaks.
+DEFAULT_WINDOWS = (60, 600)
+DEFAULT_FAST_BURN = 14.4
+DEFAULT_SLOW_BURN = 6.0
+
+
+class SLOBurnTracker:
+    """Multi-window burn-rate over a declared latency SLO (module
+    docstring). `record(ok)` is called once per completed request on
+    the batcher thread; `burn_rates()`/`payload()` run on the metrics
+    flusher. A deterministic `now` (seconds, monotonic domain) makes
+    the math unit-testable."""
+
+    def __init__(
+        self,
+        slo_ms: float,
+        objective: float = 0.99,
+        windows: Sequence[int] = DEFAULT_WINDOWS,
+    ):
+        if not 0.0 < objective < 1.0:
+            raise ValueError(f"objective must be in (0, 1), got {objective}")
+        if not windows or sorted(set(int(w) for w in windows)) != sorted(
+            int(w) for w in windows
+        ):
+            raise ValueError(f"windows must be unique and non-empty, got {windows}")
+        self.slo_ms = float(slo_ms)
+        self.objective = float(objective)
+        self.budget = 1.0 - self.objective
+        self.windows = tuple(sorted(int(w) for w in windows))
+        self._max_w = self.windows[-1]
+        self._lock = threading.Lock()
+        # per-second [sec, good, bad] buckets, oldest left; pruned on
+        # record so memory is bounded by the longest window
+        self._buckets: deque = deque()
+
+    def record(self, ok: bool, now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        sec = int(now)
+        with self._lock:
+            if not self._buckets or self._buckets[-1][0] != sec:
+                self._buckets.append([sec, 0, 0])
+            self._buckets[-1][1 if ok else 2] += 1
+            floor = sec - self._max_w
+            while self._buckets and self._buckets[0][0] <= floor:
+                self._buckets.popleft()
+
+    def burn_rates(self, now: Optional[float] = None) -> dict[int, Optional[float]]:
+        """{window_s: burn rate} — None where the window saw no
+        requests (a silent service isn't burning budget)."""
+        now = time.monotonic() if now is None else now
+        sec = int(now)
+        out: dict[int, Optional[float]] = {}
+        with self._lock:
+            buckets = list(self._buckets)
+        for w in self.windows:
+            floor = sec - w
+            good = bad = 0
+            for s, g, b in buckets:
+                if s > floor:
+                    good += g
+                    bad += b
+            total = good + bad
+            out[w] = (bad / total) / self.budget if total else None
+        return out
+
+    def payload(self, now: Optional[float] = None) -> dict:
+        """The schema'd `serve/burn_rate_<w>s` gauge family plus the
+        declared objective — merged into ServeMetrics.payload()."""
+        out = {
+            f"serve/burn_rate_{w}s": rate
+            for w, rate in self.burn_rates(now).items()
+        }
+        out["serve/slo_objective"] = self.objective
+        return out
+
+
+def serve_alert_spec(
+    slo_ms: Optional[float] = None,
+    windows: Sequence[int] = DEFAULT_WINDOWS,
+    fast_burn: float = DEFAULT_FAST_BURN,
+    slow_burn: float = DEFAULT_SLOW_BURN,
+) -> str:
+    """The serving default alert rules, in the obs/alerts.py grammar —
+    threshold rules over the burn-rate gauges (fast window at
+    `fast_burn`, slow window at `slow_burn`) plus, when `slo_ms` is
+    given, a p99-over-SLO warn. `ServeServer(alert_spec="serve_default")`
+    expands through this with its own slo/window settings; smokes pass
+    tightened values so a short run can fire."""
+    windows = tuple(sorted(int(w) for w in windows))
+    rules = [
+        f"threshold@name=slo_burn_fast:field=serve/burn_rate_{windows[0]}s:"
+        f"value={fast_burn:g}"
+    ]
+    if len(windows) > 1:
+        rules.append(
+            f"threshold@name=slo_burn_slow:field=serve/burn_rate_{windows[-1]}s:"
+            f"value={slow_burn:g}"
+        )
+    if slo_ms:
+        rules.append(
+            f"threshold@name=slo_p99_over:field=serve/p99_ms:value={float(slo_ms):g}"
+        )
+    return ",".join(rules)
+
+
+__all__ = [
+    "DEFAULT_FAST_BURN",
+    "DEFAULT_SLOW_BURN",
+    "DEFAULT_WINDOWS",
+    "SLOBurnTracker",
+    "serve_alert_spec",
+]

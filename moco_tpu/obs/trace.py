@@ -148,6 +148,40 @@ class Tracer:
             if self._f is not None and not self._f.closed:
                 self._f.write(json.dumps(rec) + "\n")
 
+    def emit_span(
+        self,
+        name: str,
+        t0: float,
+        t1: float,
+        tid: Optional[int] = None,
+        thread: Optional[str] = None,
+        **args,
+    ) -> None:
+        """Record a completed span from EXPLICIT perf_counter stamps,
+        optionally onto a virtual track (`tid`/`thread` override). This
+        is the off-thread emission path: the serving stack stamps
+        request stages on its batcher thread (obs/reqtrace.py) and a
+        flusher thread renders them here later — `span()`'s
+        enter/exit-on-the-current-thread contract can't express that."""
+        rec = {
+            "name": name,
+            "ts": round((t0 - self._t0) * 1e6, 1),
+            "dur": round((t1 - t0) * 1e6, 1),
+            "tid": threading.get_ident() if tid is None else int(tid),
+            "thread": thread or threading.current_thread().name,
+            "depth": 0,
+            "p": self.process_index,
+        }
+        if args:
+            rec["args"] = args
+        with self._lock:
+            if len(self._spans) < self.max_spans:
+                self._spans.append(rec)
+            else:
+                self._dropped += 1
+            if self._f is not None and not self._f.closed:
+                self._f.write(json.dumps(rec) + "\n")
+
     def instant(self, name: str, **args) -> None:
         """Zero-duration marker event (checkpoint committed, fault
         injected, ...) — renders as an arrow in Perfetto."""

@@ -40,6 +40,7 @@ _LAZY = {
     "ContinuousBatcher": "batcher",
     "BatcherClosedError": "batcher",
     "ServeMetrics": "batcher",
+    "DEFAULT_LATENCY_BUCKETS_MS": "batcher",
     "ServeServer": "server",
     "resolve_serve_port": "server",
 }

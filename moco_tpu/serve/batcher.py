@@ -20,20 +20,44 @@ joins the batcher thread.
 
 Metrics (`ServeMetrics`): per-request latency reservoir → p50/p99,
 completed-request QPS, batch occupancy (valid rows / padded bucket
-rows — the padding tax), a per-bucket execution histogram, and SLO
-violation counts. `payload()` emits the `serve/*` metric family the
-obs schema validates and the Prometheus sink exposes as gauges.
+rows — the padding tax), a per-bucket execution histogram, SLO
+violation counts, a cumulative latency histogram with the p99
+exemplar request id, per-stage request-trace means, and (when a
+`SLOBurnTracker` is attached) the multi-window burn-rate family.
+`payload()` emits the `serve/*` metric family the obs schema validates
+and the Prometheus sink exposes as gauges + a real
+`_bucket{le=...}` histogram.
+
+Request tracing (obs/reqtrace.py): a future may carry a
+`RequestTrace`; the batcher thread stamps `queue_wait` (per request)
+and the shared flush stages (`batch_assemble` / `engine_execute` /
+`index_query` / `scatter`) onto it — perf_counter pairs only, the
+expensive rendering happens off-path. With `reqtrace=True` the batcher
+allocates traces itself for trace-less submits (the bench serving leg's
+A/B); with tracing off the per-request cost is a `None` check.
 """
 
 from __future__ import annotations
 
+import inspect
 import queue
 import threading
 import time
+from bisect import bisect_left
 from collections import deque
 from typing import Callable, Optional
 
 import numpy as np
+
+from moco_tpu.obs.reqtrace import RequestIdAllocator, RequestTrace
+from moco_tpu.utils import faults
+
+# Cumulative latency bucket bounds (ms) for the exported histogram —
+# wide enough to cover a TPU replica at a tight SLO and the CPU smoke's
+# multi-second tail in the same ladder.
+DEFAULT_LATENCY_BUCKETS_MS = (
+    1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0,
+)
 
 
 class BatcherClosedError(RuntimeError):
@@ -62,11 +86,13 @@ class ServeFuture:
         submitted_at: float,
         want_neighbors: bool,
         mode: Optional[str] = None,
+        trace: Optional[RequestTrace] = None,
     ):
         self.num_rows = num_rows
         self.submitted_at = submitted_at
         self.want_neighbors = want_neighbors
         self.mode = mode  # neighbor tier this rider asked for (None = default)
+        self.trace = trace  # request-scoped waterfall (None = tracing off)
         self._done = threading.Event()
         self._value: Optional[dict] = None
         self._error: Optional[BaseException] = None
@@ -94,7 +120,13 @@ class ServeMetrics:
     """Thread-safe serving gauges; `payload()` is the schema'd
     `serve/*` line (README "metrics.jsonl line format")."""
 
-    def __init__(self, slo_ms: float, window: int = 2048):
+    def __init__(
+        self,
+        slo_ms: float,
+        window: int = 2048,
+        burn=None,
+        latency_buckets_ms=DEFAULT_LATENCY_BUCKETS_MS,
+    ):
         self.slo_ms = float(slo_ms)
         self._lock = threading.Lock()
         self._latencies_ms: deque = deque(maxlen=window)
@@ -107,6 +139,19 @@ class ServeMetrics:
         self._started_at = time.perf_counter()
         self._win_t0 = self._started_at
         self._win_completed = 0
+        # multi-window SLO burn-rate tracker (obs/slo.py); fed one
+        # ok/violation observation per completed request
+        self.burn = burn
+        # cumulative latency histogram (lifetime counters, Prometheus
+        # semantics) + the window's worst request as the p99 exemplar
+        self._hist_le = tuple(float(b) for b in latency_buckets_ms)
+        self._hist_counts = [0] * (len(self._hist_le) + 1)
+        self._hist_sum_ms = 0.0
+        self._hist_count = 0
+        self._exemplar: Optional[tuple[float, str]] = None  # (ms, request_id)
+        # per-stage request-trace sums over the current payload window
+        self._stage_sums_ms: dict[str, float] = {}
+        self._stage_reqs = 0
 
     def record_recall(self, recall: float) -> None:
         """One sampled online recall@k observation (approximate tier vs
@@ -115,7 +160,12 @@ class ServeMetrics:
         with self._lock:
             self._recalls.append(float(recall))
 
-    def record_request(self, latency_s: float) -> None:
+    def record_request(
+        self,
+        latency_s: float,
+        request_id: Optional[str] = None,
+        trace: Optional[RequestTrace] = None,
+    ) -> None:
         ms = latency_s * 1e3
         with self._lock:
             self._latencies_ms.append(ms)
@@ -123,6 +173,21 @@ class ServeMetrics:
             self._win_completed += 1
             if ms > self.slo_ms:
                 self._violations += 1
+            self._hist_counts[bisect_left(self._hist_le, ms)] += 1
+            self._hist_sum_ms += ms
+            self._hist_count += 1
+            if request_id is not None and (
+                self._exemplar is None or ms > self._exemplar[0]
+            ):
+                self._exemplar = (ms, request_id)
+            if trace is not None:
+                for stage, dur_ms in trace.stage_ms().items():
+                    self._stage_sums_ms[stage] = (
+                        self._stage_sums_ms.get(stage, 0.0) + dur_ms
+                    )
+                self._stage_reqs += 1
+        if self.burn is not None:
+            self.burn.record(ms <= self.slo_ms)
 
     def record_flush(self, executed: list[tuple[int, int]]) -> None:
         with self._lock:
@@ -160,10 +225,49 @@ class ServeMetrics:
                 "serve/recall_estimate": (
                     sum(self._recalls) / len(self._recalls) if self._recalls else None
                 ),
+                # cumulative latency histogram (lifetime, per-bucket
+                # counts — the Prometheus sink cumulates at render) with
+                # the window's worst request attached as the exemplar
+                "serve/latency_hist": {
+                    "le": list(self._hist_le),
+                    "counts": list(self._hist_counts),
+                    "sum": round(self._hist_sum_ms, 3),
+                    "count": self._hist_count,
+                    **(
+                        {
+                            "exemplar": {
+                                "request_id": self._exemplar[1],
+                                "latency_ms": round(self._exemplar[0], 3),
+                            }
+                        }
+                        if self._exemplar is not None
+                        else {}
+                    ),
+                },
+                # the p99 exemplar: WHICH request the latency gauges
+                # blame (the window's worst; null with tracing off)
+                "serve/p99_exemplar": (
+                    self._exemplar[1] if self._exemplar is not None else None
+                ),
+                "serve/p99_exemplar_ms": (
+                    round(self._exemplar[0], 3) if self._exemplar is not None else None
+                ),
             }
+            # stage waterfall means over the window (request tracing on)
+            if self._stage_reqs:
+                for stage, total in sorted(self._stage_sums_ms.items()):
+                    out[f"serve/trace_{stage}_ms"] = round(
+                        total / self._stage_reqs, 3
+                    )
+                out["serve/trace_requests"] = self._stage_reqs
+            self._exemplar = None
+            self._stage_sums_ms = {}
+            self._stage_reqs = 0
             for bucket, count in sorted(self._bucket_counts.items()):
                 out[f"serve/bucket_{bucket}"] = count
-            return out
+        if self.burn is not None:
+            out.update(self.burn.payload())
+        return out
 
 
 class ContinuousBatcher:
@@ -183,21 +287,34 @@ class ContinuousBatcher:
         slo_ms: float = 100.0,
         queue_depth: int = 256,
         metrics: Optional[ServeMetrics] = None,
+        reqtrace: bool = False,
+        replica_index: int = 0,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         self._run_batch = run_batch
-        # a 3-arg run_batch additionally receives the sorted tuple of
-        # per-request neighbor modes in the micro-batch (the IVF server
-        # path); 2-arg callables keep the original contract
+        # a run_batch with >= 3 POSITIONAL params additionally receives
+        # the sorted tuple of per-request neighbor modes in the
+        # micro-batch (the IVF server path); 2-arg callables keep the
+        # original contract. A keyword-only `stages` param opts into
+        # per-stage timing (the engine splits engine_execute /
+        # index_query there) — keyword-only so a stages-aware 2-arg
+        # callable is not mistaken for the modes contract.
         try:
-            import inspect
-
-            self._pass_modes = (
-                len(inspect.signature(run_batch).parameters) >= 3
-            )
+            params = inspect.signature(run_batch).parameters
+            positional = [
+                p for p in params.values()
+                if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+            ]
+            self._pass_modes = len(positional) >= 3
+            self._pass_stages = "stages" in params
         except (TypeError, ValueError):
             self._pass_modes = False
+            self._pass_stages = False
+        # reqtrace=True: allocate a RequestTrace for trace-less submits
+        # (standalone batcher use — bench A/B, tests); the server passes
+        # traces explicitly so the ingress stage is already stamped
+        self._ids = RequestIdAllocator(replica_index) if reqtrace else None
         self.max_batch = int(max_batch)
         self.slo_ms = float(slo_ms)
         # half the SLO budget may be spent coalescing; the rest belongs
@@ -218,16 +335,22 @@ class ContinuousBatcher:
         images: np.ndarray,
         want_neighbors: bool = False,
         mode: Optional[str] = None,
+        trace: Optional[RequestTrace] = None,
     ) -> ServeFuture:
         """Enqueue an (n, H, W, C) uint8 request; returns its future.
         `mode` names the neighbor tier this rider wants (exact/ivf/...;
-        None = the server default). Raises BatcherClosedError when the
-        batcher is shut (including a producer that was blocked on a full
-        queue during close)."""
+        None = the server default); `trace` is an optional ingress
+        -stamped RequestTrace (auto-allocated under reqtrace=True).
+        Raises BatcherClosedError when the batcher is shut (including a
+        producer that was blocked on a full queue during close)."""
         images = np.asarray(images, np.uint8)
         if images.ndim != 4 or images.shape[0] < 1:
             raise ValueError(f"request must be (n>=1, H, W, C) uint8, got {images.shape}")
-        fut = ServeFuture(images.shape[0], time.perf_counter(), want_neighbors, mode)
+        if trace is None and self._ids is not None:
+            trace = self._ids.new_trace(images.shape[0])
+        fut = ServeFuture(
+            images.shape[0], time.perf_counter(), want_neighbors, mode, trace
+        )
         if self._stop.is_set() or not _responsive_put(self._q, self._stop, (images, fut)):
             raise BatcherClosedError("batcher is closed")
         return fut
@@ -237,27 +360,77 @@ class ContinuousBatcher:
     def _flush(self, pending: list) -> None:
         if not pending:
             return
+        # queue_wait closes for every rider the moment its flush begins;
+        # the remaining stages are flush-shared (reqtrace.py semantics)
+        t_flush = time.perf_counter()
+        tracing = any(f.trace is not None for _, f in pending)
+        if tracing:
+            for _, fut in pending:
+                if fut.trace is not None:
+                    fut.trace.stamp("queue_wait", fut.submitted_at, t_flush)
+        faults.maybe_slow("serve.batch_assemble")
         images = np.concatenate([img for img, _ in pending])
+        t_assembled = time.perf_counter()
         want_neighbors = any(f.want_neighbors for _, f in pending)
+        stages: Optional[dict] = {} if (tracing and self._pass_stages) else None
         try:
+            t_run0 = time.perf_counter()
             if self._pass_modes:
                 modes = tuple(sorted(
                     {f.mode for _, f in pending if f.want_neighbors and f.mode}
                 ))
-                results, executed = self._run_batch(images, want_neighbors, modes)
+                if stages is not None:
+                    results, executed = self._run_batch(
+                        images, want_neighbors, modes, stages=stages
+                    )
+                else:
+                    results, executed = self._run_batch(images, want_neighbors, modes)
+            elif stages is not None:
+                results, executed = self._run_batch(
+                    images, want_neighbors, stages=stages
+                )
             else:
                 results, executed = self._run_batch(images, want_neighbors)
+            t_run1 = time.perf_counter()
         except BaseException as e:
             for _, fut in pending:
                 fut._fail(e)
             return
         self.metrics.record_flush(executed)
+        if tracing:
+            # synthesize contiguous engine/query intervals from the run
+            # window: durations are exact, starts are stacked (the real
+            # device work interleaves per chunk — reqtrace.py docstring)
+            if stages:
+                engine_s = stages.get("engine_execute", 0.0)
+                query_s = stages.get("index_query", 0.0)
+                untimed = max((t_run1 - t_run0) - engine_s - query_s, 0.0)
+                engine_s += untimed  # residual host work rides the engine stage
+            else:
+                engine_s, query_s = t_run1 - t_run0, 0.0
+        faults.maybe_slow("serve.scatter")
+        t_scatter = time.perf_counter()
         offset = 0
         for _, fut in pending:
             rows = slice(offset, offset + fut.num_rows)
+            if fut.trace is not None:
+                tr = fut.trace
+                tr.stamp("batch_assemble", t_flush, t_assembled)
+                tr.stamp("engine_execute", t_run0, t_run0 + engine_s)
+                if query_s > 0.0:
+                    tr.stamp(
+                        "index_query", t_run0 + engine_s, t_run0 + engine_s + query_s
+                    )
+                # scatter closes at THIS request's resolve, so the
+                # per-request stage sum tracks its measured latency
+                tr.stamp("scatter", t_scatter, time.perf_counter())
             fut._resolve({k: v[rows] for k, v in results.items()})
             offset += fut.num_rows
-            self.metrics.record_request(fut.latency_s)
+            self.metrics.record_request(
+                fut.latency_s,
+                request_id=fut.trace.req_id if fut.trace is not None else None,
+                trace=fut.trace,
+            )
 
     def _loop(self) -> None:
         pending: list = []
@@ -321,6 +494,7 @@ class ContinuousBatcher:
 __all__ = [
     "BatcherClosedError",
     "ContinuousBatcher",
+    "DEFAULT_LATENCY_BUCKETS_MS",
     "ServeFuture",
     "ServeMetrics",
 ]

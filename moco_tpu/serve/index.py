@@ -67,6 +67,7 @@ import numpy as np
 
 from moco_tpu.ops.losses import l2_normalize
 from moco_tpu.parallel.mesh import DATA_AXIS
+from moco_tpu.utils import faults
 
 DEFAULT_KMEANS_ITERS = 10
 # modes query()/prepare() understand; "*_i8" score in int8 (enable_int8)
@@ -711,6 +712,9 @@ class EmbeddingIndex:
         the tier: "exact" (the oracle), "ivf" (sub-linear probe scan,
         `nprobe` cells — defaults to the trained width), and their int8
         twins "exact_i8"/"ivf_i8"."""
+        # deterministic tail injection for the request-trace waterfall's
+        # index_query stage (slow@site=serve.index_query)
+        faults.maybe_slow("serve.index_query")
         q = jnp.asarray(queries, jnp.float32)
         m, k = q.shape[0], int(k)
         np_eff = self._require(mode, nprobe)

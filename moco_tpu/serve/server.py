@@ -39,6 +39,22 @@ Prometheus owns `metrics_port + process_index`, the server claims
 `serve_port + process_index` and shifts by SERVE_PORT_STRIDE when the
 two meet.
 
+Request-scoped observability (PR 10, obs/{reqtrace,slo,flight}.py):
+with `reqtrace=True` (the default) every request gets a replica-scoped
+id and a stage-stamped waterfall (`ingress -> queue_wait ->
+batch_assemble -> engine_execute -> index_query -> scatter ->
+respond`); completed waterfalls feed a bounded flight-recorder ring,
+the `serve/trace_<stage>_ms` window means, the latency histogram's p99
+exemplar, and — when a `workdir` is given — Perfetto request spans on
+virtual "requests" lanes in `trace_events.s<replica>.jsonl` (the
+`heartbeat.s<replica>.json` anchor lets scripts/trace_merge.py align
+them with the training timeline). An `SLOBurnTracker` turns the
+declared `slo_ms` into multi-window `serve/burn_rate_<w>s` gauges; an
+`AlertEngine` over the flush stream (`alert_spec="serve_default"` =
+obs/slo.py's threshold rules) dumps the flight recorder to
+`flight_<ts>.json` the moment a rule fires, and `GET /debug/flight`
+dumps it on demand.
+
 Thread hygiene (JX011): the HTTP server thread and the metrics flusher
 are both joined in `close()`, the flusher polls a stop event, and the
 batcher's own close fails stragglers loudly.
@@ -48,13 +64,23 @@ from __future__ import annotations
 
 import http.server
 import json
+import os
+import socket
 import threading
+import time
+from collections import deque
 
 import numpy as np
 
+from moco_tpu.obs.alerts import AlertEngine, parse_rules
+from moco_tpu.obs.flight import FlightRecorder
+from moco_tpu.obs.reqtrace import RequestIdAllocator, emit_request_spans
 from moco_tpu.obs.sinks import resolve_serve_port  # noqa: F401  (public API)
+from moco_tpu.obs.slo import DEFAULT_WINDOWS, SLOBurnTracker, serve_alert_spec
+from moco_tpu.obs.trace import Tracer, get_tracer
 from moco_tpu.serve.batcher import BatcherClosedError, ContinuousBatcher, ServeMetrics
 from moco_tpu.serve.index import QUERY_MODES
+from moco_tpu.utils import faults
 
 DEFAULT_NEIGHBORS_K = 5
 DEFAULT_RECALL_SAMPLE_EVERY = 8
@@ -83,6 +109,13 @@ class ServeServer:
         sink=None,
         metrics_flush_s: float = 1.0,
         warmup: bool = True,
+        workdir: str = None,
+        replica_index: int = 0,
+        reqtrace: bool = True,
+        slo_objective: float = 0.99,
+        burn_windows=DEFAULT_WINDOWS,
+        alert_spec: str = "serve_default",
+        flight_requests: int = 512,
     ):
         if neighbors_mode not in QUERY_MODES:
             raise ValueError(
@@ -94,7 +127,54 @@ class ServeServer:
         self.neighbors_mode = neighbors_mode
         self.nprobe = int(nprobe) or None
         self.recall_sample_every = int(recall_sample_every)
-        self.metrics = ServeMetrics(slo_ms)
+        self.workdir = workdir
+        self.replica_index = int(replica_index)
+        # request-scoped observability: replica-tagged ids + waterfalls,
+        # burn-rate accounting over the declared SLO, flight recorder,
+        # and the alert engine that trips the flight dump (module
+        # docstring). All off the request path except the stamps.
+        self._ids = RequestIdAllocator(self.replica_index) if reqtrace else None
+        burn = SLOBurnTracker(slo_ms, objective=slo_objective, windows=burn_windows)
+        self.metrics = ServeMetrics(slo_ms, burn=burn)
+        self.flight = FlightRecorder(
+            max_requests=flight_requests, replica=self.replica_index
+        )
+        spec = (
+            serve_alert_spec(slo_ms, windows=burn.windows)
+            if alert_spec == "serve_default"
+            else alert_spec
+        )
+        self._alerts = (
+            AlertEngine(
+                parse_rules(spec),
+                workdir=workdir,
+                process_index=self.replica_index,
+                on_fire=self._on_alert,
+            )
+            if spec
+            else None
+        )
+        # per-replica Perfetto stream for request spans: reuse the
+        # installed process tracer when one exists (co-hosted with a
+        # training driver); otherwise open our own replica stream next
+        # to the training family, with a serve heartbeat anchor so
+        # trace_merge can clock-align it
+        self._tracer = get_tracer()
+        self._own_tracer = None
+        if self._tracer is None and workdir:
+            self._own_tracer = self._tracer = Tracer(
+                jsonl_path=os.path.join(
+                    workdir, f"trace_events.s{self.replica_index}.jsonl"
+                ),
+                process_index=self.replica_index,
+            )
+        if workdir and self._tracer is not None:
+            self._write_serve_anchor()
+        # completed traces awaiting span emission — drained by the
+        # metrics flusher thread, bounded so a stalled flusher degrades
+        # to dropped spans rather than unbounded memory
+        self._span_pending: deque = deque(maxlen=4 * flight_requests)
+        self._lane = 0
         self._sink = sink
         self._flush_step = 0
         self._neighbor_flushes = 0
@@ -132,10 +212,22 @@ class ServeServer:
                     self._json(200, {"ok": True, "warm": server.engine.recompiles_after_warmup == 0})
                 elif path == "/stats":
                     self._json(200, server.stats())
+                elif path == "/debug/flight":
+                    # on-demand flight dump: write the ring to disk when
+                    # a workdir exists, and return the snapshot either
+                    # way (the live-debugging path)
+                    body = server.flight.snapshot()
+                    if server.workdir:
+                        body["dump_path"] = server.flight.dump(
+                            server.workdir, reason="debug_request",
+                            extra={"slo_ms": server.metrics.slo_ms},
+                        )
+                    self._json(200, body)
                 else:
                     self.send_error(404)
 
             def do_POST(self):  # noqa: N802
+                t_arrival = time.perf_counter()
                 path, _, query = self.path.partition("?")
                 if path == "/ingest":
                     self._handle_ingest()
@@ -143,6 +235,7 @@ class ServeServer:
                 if path not in ("/embed", "/neighbors"):
                     self.send_error(404)
                     return
+                faults.maybe_slow("serve.ingress")
                 try:
                     images = self._read_images()
                 except ValueError as e:
@@ -163,14 +256,22 @@ class ServeServer:
                             f"(serving: {sorted(server._prepared_modes)})"
                         })
                         return
+                trace = None
+                if server._ids is not None:
+                    # backdated to arrival so the ingress stage covers
+                    # the body read + parse above
+                    trace = server._ids.new_trace(images.shape[0], t0=t_arrival)
+                    trace.stamp("ingress", t_arrival, time.perf_counter())
                 try:
                     fut = server.batcher.submit(
-                        images, want_neighbors=want_neighbors, mode=mode
+                        images, want_neighbors=want_neighbors, mode=mode, trace=trace
                     )
                     out = fut.result(timeout=30.0)
                 except (BatcherClosedError, TimeoutError) as e:
                     self._json(503, {"error": str(e)})
                     return
+                faults.maybe_slow("serve.respond")
+                t_respond = time.perf_counter()
                 body = {"embedding": out["embedding"].tolist()}
                 if want_neighbors:
                     k = _query_k(query, server.neighbors_k)
@@ -178,7 +279,12 @@ class ServeServer:
                     body["indices"] = out[f"indices:{eff}"][:, :k].tolist()
                     body["scores"] = out[f"scores:{eff}"][:, :k].tolist()
                     body["mode"] = eff
+                if trace is not None:
+                    body["request_id"] = trace.req_id
                 self._json(200, body)
+                if trace is not None:
+                    trace.stamp("respond", t_respond, time.perf_counter())
+                    server._complete(trace)
 
             def _handle_ingest(self):
                 """FIFO-ingest a raw f32 row block into the live index —
@@ -263,14 +369,16 @@ class ServeServer:
 
     # -- request path ----------------------------------------------------
 
-    def _run_batch(self, images, want_neighbors, modes=()):
+    def _run_batch(self, images, want_neighbors, modes=(), *, stages=None):
         """Batcher thread body: ONE padded engine execution per flush,
         then one index query per requested tier on the same features
         (the scans are small matmuls next to the encoder forward);
         /embed riders just drop the extra keys at scatter. With an
         approximate default tier, every `recall_sample_every`-th
         neighbors flush also runs the exact oracle and records the
-        top-k overlap (`serve/recall_estimate`)."""
+        top-k overlap (`serve/recall_estimate`). `stages` (keyword-only,
+        the batcher's request-trace contract) splits engine_execute /
+        index_query seconds for the waterfall."""
         if want_neighbors and self.index is not None:
             requested = {self.neighbors_mode} | set(modes)
             approx = next(
@@ -288,6 +396,7 @@ class ServeServer:
                 emb, per_mode, executed = self.engine.embed_and_query_modes(
                     images, self.index, self.neighbors_k,
                     modes=tuple(sorted(requested)), nprobe=self.nprobe,
+                    stages=stages,
                 )
             if sample_recall:
                 _, exact_idx = per_mode["exact"]
@@ -303,8 +412,78 @@ class ServeServer:
                 results[f"scores:{m}"] = scores
                 results[f"indices:{m}"] = idx
             return results, executed
-        emb, executed = self.engine.embed(images)
+        emb, executed = self.engine.embed(images, stages=stages)
         return {"embedding": emb}, executed
+
+    # -- request-scoped observability ------------------------------------
+
+    def _complete(self, trace) -> None:
+        """A request finished responding: file its waterfall in the
+        flight ring and queue it for span emission (both O(1); the
+        rendering happens on the flusher thread)."""
+        self.flight.record_request(trace.waterfall())
+        self._span_pending.append(trace)
+
+    def _drain_spans(self) -> None:
+        """Flusher-thread side of `_complete`: render queued request
+        waterfalls as Perfetto spans on the virtual request lanes."""
+        if self._tracer is None:
+            self._span_pending.clear()
+            return
+        while True:
+            try:
+                trace = self._span_pending.popleft()
+            except IndexError:
+                break
+            emit_request_spans(self._tracer, trace, self._lane)
+            self._lane += 1
+
+    def _on_alert(self, alert: dict) -> None:
+        """AlertEngine on_fire hook: an SLO-burn (or any serving) alert
+        dumps the flight recorder AT the firing edge and lands an
+        in-band alert event line, so scrapers see `moco_alert_<rule>`
+        and the postmortem file already exists when a human arrives."""
+        if self.workdir:
+            try:
+                self.flight.dump(
+                    self.workdir,
+                    reason=f"alert:{alert['rule']}",
+                    extra={
+                        "alert": alert,
+                        "slo_ms": self.metrics.slo_ms,
+                        "replica": self.replica_index,
+                    },
+                )
+            except Exception as e:  # the dump must never take serving down
+                print(f"WARNING: flight dump failed: {e!r}", flush=True)
+        if self._sink is not None:
+            self._sink.write(
+                self._flush_step,
+                {
+                    "event": "alert",
+                    "alert": alert["rule"],
+                    "severity": alert["severity"],
+                    f"alert/{alert['rule']}": 1.0,
+                },
+            )
+
+    def _write_serve_anchor(self) -> None:
+        """Atomic `heartbeat.s<replica>.json` with the tracer's wall
+        anchor — scripts/trace_merge.py reads it to clock-align this
+        replica's request spans with the training timeline."""
+        rec = {
+            "process": self.replica_index,
+            "role": "serve",
+            "host": socket.gethostname(),
+            "pid": os.getpid(),
+            "time": time.time(),
+            "trace_wall_t0": self._tracer.wall_t0,
+        }
+        path = os.path.join(self.workdir, f"heartbeat.s{self.replica_index}.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+        os.replace(tmp, path)
 
     # -- metrics ---------------------------------------------------------
 
@@ -333,11 +512,19 @@ class ServeServer:
             self._write_metrics()
 
     def _write_metrics(self) -> None:
-        if self._sink is None:
-            return
+        """One off-path observability turn: snapshot the gauges, feed
+        the flight ring + alert engine (a fired rule dumps the ring via
+        `_on_alert`), render pending request spans, then fan the line
+        out to the sink."""
         self._flush_step += 1
         try:
-            self._sink.write(self._flush_step, self.stats())
+            payload = self.stats()
+            self.flight.record_metrics(self._flush_step, payload)
+            if self._alerts is not None:
+                self._alerts.observe(self._flush_step, payload)
+            self._drain_spans()
+            if self._sink is not None:
+                self._sink.write(self._flush_step, payload)
         except Exception as e:  # metrics must never take serving down
             print(f"WARNING: serve metrics sink failed: {e!r}", flush=True)
 
@@ -354,6 +541,10 @@ class ServeServer:
         self._thread.join(timeout=5.0)
         self.batcher.close()
         self._write_metrics()
+        if self._alerts is not None:
+            self._alerts.close()
+        if self._own_tracer is not None:
+            self._own_tracer.close()
 
 
 def _query_param(query: str, name: str) -> str | None:

@@ -36,6 +36,22 @@ Spec grammar: comma-separated faults, each `kind@key=val[:key=val...]`:
                                   signature) — exercises the runtime
                                   collective-schedule sanitizer without
                                   a real divergent pod
+    slow@site=S:ms=X[:at=K:times=M]
+                                  sleep X *milliseconds* on calls
+                                  K..K+M-1 (1-based; default: every
+                                  call) at serving-stage site S —
+                                  deterministic tail-latency injection
+                                  for the request-trace waterfall.
+                                  Sites are the serve stage hooks:
+                                  serve.ingress, serve.batch_assemble,
+                                  serve.engine_execute,
+                                  serve.index_query, serve.scatter,
+                                  serve.respond. The sleep happens
+                                  INSIDE the stage's stamped interval,
+                                  so the flight recorder must attribute
+                                  the injected tail to exactly that
+                                  stage (the serve_smoke SLO leg's
+                                  acceptance check)
 
 Example:
     MOCO_FAULTS="ckpt_truncate@step=8,io@site=data.read:at=3,nan@step=6"
@@ -55,10 +71,10 @@ import time
 from collections import Counter
 from typing import Optional
 
-KINDS = ("ckpt_truncate", "io", "nan", "stall", "preempt", "delay", "diverge")
+KINDS = ("ckpt_truncate", "io", "nan", "stall", "preempt", "delay", "diverge", "slow")
 
 _INT_KEYS = ("step", "at", "times")
-_FLOAT_KEYS = ("seconds",)
+_FLOAT_KEYS = ("seconds", "ms")
 _STR_KEYS = ("site",)
 
 
@@ -125,6 +141,24 @@ class FaultPlan:
             times = p.get("times")
             if n >= at and (times is None or n < at + times):
                 time.sleep(p["seconds"])
+
+    def maybe_slow(self, site: str) -> None:
+        """Millisecond-scale serving-stage sleep — `delay@`'s twin for
+        the request path, on its own counter namespace so a slow@ and a
+        delay@ rule on one site can't perturb each other's schedules.
+        The serve stage hooks call this inside the stamped interval, so
+        injected tail latency is attributed to the right stage."""
+        key = f"slow:{site}"
+        with self._lock:
+            self._io_counts[key] += 1
+            n = self._io_counts[key]
+        for kind, p in self.rules:
+            if kind != "slow" or p.get("site", site) != site:
+                continue
+            at = p.get("at", 1)
+            times = p.get("times")
+            if n >= at and (times is None or n < at + times):
+                time.sleep(p["ms"] / 1e3)
 
     def corrupt_loss(self, loss: float, step: int) -> float:
         for kind, p in self.rules:
@@ -233,6 +267,11 @@ def maybe_io_error(site: str) -> None:
 def maybe_delay(site: str) -> None:
     if _PLAN is not None:
         _PLAN.maybe_delay(site)
+
+
+def maybe_slow(site: str) -> None:
+    if _PLAN is not None:
+        _PLAN.maybe_slow(site)
 
 
 def corrupt_loss(loss: float, step: int) -> float:

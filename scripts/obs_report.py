@@ -18,6 +18,11 @@ present):
   the out-of-band heartbeat files, so a dead host still appears;
 - comms: per-collective-site analytic wire bytes per step (from the
   `comms/*` counters) with a share-of-total bar;
+- serving: the request-stage waterfall as an ASCII pie (from the
+  `serve/trace_<stage>_ms` window means), qps/p99 trends, the SLO
+  burn-rate curve per window (`serve/burn_rate_*` sparkline), and the
+  top-N slowest requests with their full stage waterfalls from the
+  newest flight-recorder dump (`flight_*.json`) when one exists;
 - alerts: every fired alert from alerts.jsonl, grouped by rule;
 - training-health trends: loss/accuracy, EMA drift, InfoNCE pos/neg
   logit margin, feature-collapse gauges, queue staleness — first→last
@@ -65,6 +70,23 @@ def _fmt(v) -> str:
     if isinstance(v, float):
         return f"{v:.4g}"
     return str(v)
+
+
+_SPARK_CHARS = " .:-=+*#%@"
+
+
+def _spark(vals: list, width: int = 32) -> str:
+    """Tiny ASCII sparkline of a series (downsampled to `width`), scaled
+    to its own max — the burn-rate curve without a plotting dep."""
+    if not vals:
+        return ""
+    if len(vals) > width:
+        step = len(vals) / width
+        vals = [vals[int(i * step)] for i in range(width)]
+    top = max(max(vals), 1e-12)
+    idx = [min(int(v / top * (len(_SPARK_CHARS) - 1) + 0.5), len(_SPARK_CHARS) - 1)
+           for v in vals]
+    return "[" + "".join(_SPARK_CHARS[i] for i in idx) + "]"
 
 
 def _trend(lines: list[dict], key: str) -> str | None:
@@ -238,6 +260,79 @@ def render_report(
               f"({nbytes / 2**20:.2f} MiB/step)")
         w(f"  total: {total / 2**20:.2f} MiB/step per device "
           f"(collective cost model: moco_tpu/obs/comms.py)")
+        w("")
+
+    # -- serving (request-scoped observability) --------------------------
+    serve_lines = [r for r in records if any(k.startswith("serve/") for k in r)]
+    if serve_lines:
+        w("## Serving")
+        w("")
+        last = serve_lines[-1]
+        reqs = last.get("serve/requests")
+        if isinstance(reqs, (int, float)):
+            w(f"requests: {int(reqs)}, slo {_fmt(last.get('serve/slo_ms'))} ms "
+              f"(objective {_fmt(last.get('serve/slo_objective'))}), "
+              f"violations {_fmt(last.get('serve/slo_violations'))}")
+        for key in ("serve/qps", "serve/p99_ms", "serve/p50_ms", "serve/occupancy"):
+            t = _trend(serve_lines, key)
+            if t is not None:
+                w(f"- `{key}`: {t}")
+        ex = next(
+            (r["serve/p99_exemplar"] for r in reversed(serve_lines)
+             if isinstance(r.get("serve/p99_exemplar"), str)),
+            None,
+        )
+        if ex is not None:
+            w(f"- worst recent request (p99 exemplar): `{ex}`")
+        # stage waterfall pie: the latest line carrying trace means
+        stage_line = next(
+            (r for r in reversed(serve_lines)
+             if any(k.startswith("serve/trace_") and k.endswith("_ms") for k in r)),
+            None,
+        )
+        if stage_line:
+            stages = {
+                k[len("serve/trace_"):-len("_ms")]: v
+                for k, v in stage_line.items()
+                if k.startswith("serve/trace_") and k.endswith("_ms")
+                and isinstance(v, (int, float))
+            }
+            total = sum(stages.values()) or 1.0
+            w("")
+            w("stage waterfall (mean ms/request, latest window):")
+            for name, ms in sorted(stages.items(), key=lambda kv: -kv[1]):
+                frac = ms / total
+                w(f"  {name:<16} {_bar(frac)} {frac * 100:5.1f}%  ({ms:.1f} ms)")
+        # burn-rate curve: one sparkline per window
+        burn_keys = sorted(
+            {k for r in serve_lines for k in r if k.startswith("serve/burn_rate_")}
+        )
+        for key in burn_keys:
+            vals = [r[key] for r in serve_lines if isinstance(r.get(key), (int, float))]
+            if vals:
+                w(f"- `{key}`: {_spark(vals)}  last {_fmt(vals[-1])} "
+                  f"(max {_fmt(max(vals))}; >1 = burning budget faster "
+                  "than the SLO period sustains)")
+        # top-N slowest requests from the newest flight dump
+        dumps = sorted(globmod.glob(os.path.join(workdir, "flight_*.json"))) if workdir else []
+        if dumps:
+            try:
+                with open(dumps[-1]) as f:
+                    dump = json.load(f)
+            except ValueError:
+                dump = None
+            if dump and dump.get("slowest"):
+                w("")
+                w(f"slowest requests (flight recorder `{os.path.basename(dumps[-1])}`, "
+                  f"reason: {dump.get('reason', '?')}):")
+                for wf in dump["slowest"][:5]:
+                    stages_str = " ".join(
+                        f"{s['stage']}={s['dur_ms']:.0f}ms"
+                        for s in wf.get("stages", [])
+                    )
+                    w(f"- `{wf.get('request_id', '?')}` "
+                      f"({wf.get('total_ms', 0):.0f} ms, {wf.get('rows', '?')} rows): "
+                      f"{stages_str}")
         w("")
 
     # -- alerts ----------------------------------------------------------

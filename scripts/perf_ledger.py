@@ -41,6 +41,13 @@ CPU_SMOKE_THRESHOLD = 0.50
 # recall@10 floor for the ANN series (CONTRIBUTING: the review gate) —
 # qps wins bought by recall losses fail the build
 ANN_RECALL_FLOOR = 0.95
+# request-tracing overhead caps for the serving series (ISSUE 10
+# acceptance: tracing ON must cost < 5% qps). The CPU smoke gets the
+# same widened treatment as its regression threshold — the two
+# closed-loop passes run minutes apart on a shared 1-core runner, so
+# their qps delta carries scheduler jitter far beyond the tracing cost.
+TRACE_OVERHEAD_CAP_ACCEL = 5.0
+TRACE_OVERHEAD_CAP_CPU = 25.0
 
 # bench-JSON fields copied into a ledger entry when present
 TRACKED_FIELDS = (
@@ -164,6 +171,26 @@ def check(ledger_path: str, input_path: str, threshold: float | None = None) -> 
             threshold,
             lambda e: e.get("serving"),
         )
+        # hard cap on the request-tracing overhead (per-request
+        # waterfalls must stay ~free or serving runs them off in prod)
+        overhead = serving.get("trace_overhead_pct")
+        if overhead is not None:
+            cap = (
+                TRACE_OVERHEAD_CAP_CPU
+                if "cpu_smoke" in serving["metric"]
+                else TRACE_OVERHEAD_CAP_ACCEL
+            )
+            if overhead > cap:
+                print(
+                    f"perf gate [FAIL] {serving['metric']}: request-tracing "
+                    f"overhead {overhead:.1f}% above the {cap:g}% cap"
+                )
+                rc |= 1
+            else:
+                print(
+                    f"perf gate [PASS] {serving['metric']}: request-tracing "
+                    f"overhead {overhead:.1f}% (cap {cap:g}%)"
+                )
     # third gated series since the IVF tier: approximate-NN queries/s
     # (the sub-linear retrieval headline) — same most-recent-comparable
     # rule; additionally a recall@10 FLOOR (an ANN index that got fast

@@ -30,11 +30,21 @@ The story (the ISSUE-8 acceptance bullet, executable):
    neighbors flush) — asserts ZERO recompiles after warmup on the IVF
    path, the online `serve/recall_estimate` at or above the recall
    floor, p99 ≤ the smoke SLO, and the `serve/nprobe`/`serve/int8`
-   gauges schema-strict.
+   gauges schema-strict;
+7. the SLO-violation leg (ISSUE 10): a third server boots with request
+   tracing, a tight SLO, short burn windows, and a tightened burn
+   threshold; after a healthy baseline, `slow@site=serve.engine_execute`
+   injects a deterministic tail — asserts the burn-rate alert FIRES
+   (alerts.jsonl), the flight recorder DUMPED (`flight_*.json` under
+   `slo_leg/`, a CI artifact), the dump contains the slowed requests'
+   full stage waterfalls with `engine_execute` correctly dominating,
+   `/debug/flight` answers on demand, and the flushed
+   `serve/burn_rate_*` + `serve/trace_*` lines are schema-strict.
 
 CI runs this in the tier-1 job and uploads the workdir (metrics.jsonl +
-serve_smoke.json summary) as an artifact. Wall cost: one tiny-model
-AOT warmup + ~260 small requests, well under a minute on a CPU host.
+serve_smoke.json summary + the SLO leg's flight dump) as an artifact.
+Wall cost: one tiny-model AOT warmup + ~300 small requests, well under
+a minute on a CPU host.
 """
 
 from __future__ import annotations
@@ -45,6 +55,7 @@ import os
 import sys
 import tempfile
 import threading
+import time
 import urllib.request
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
@@ -77,6 +88,18 @@ IVF_DICT_ROWS = 256
 IVF_NLIST = 16
 IVF_NPROBE = 12
 RECALL_FLOOR = float(os.environ.get("SERVE_SMOKE_RECALL_FLOOR", 0.95))
+# SLO leg (ISSUE 10). Sizing: sequential 1-request traffic flushes at
+# the batcher's slo/2 coalescing deadline, so baseline latency is
+# ~slo/2 + compute — the 800ms SLO leaves CI-jitter headroom for the
+# baseline while the injected 3x-SLO sleep violates decisively. Short
+# burn windows so the smoke's seconds of traffic fill them, and a burn
+# threshold of 1.0 (= "budget exhausts before the period ends")
+# instead of the production 14.4 pager so a short run can trip it:
+# 4 slowed among ~16 window requests at objective 0.9 burns at ~2.5.
+SLO_LEG_SLO_MS = float(os.environ.get("SERVE_SMOKE_SLO_LEG_SLO_MS", 800.0))
+SLO_LEG_SLOW_MS = 3.0 * SLO_LEG_SLO_MS
+SLO_LEG_REQUESTS = 12
+SLO_LEG_SLOWED = 4
 
 
 def make_toy_checkpoint(workdir: str):
@@ -218,6 +241,9 @@ def run_smoke(workdir: str) -> dict:
     # -- leg 6: the IVF retrieval tier ----------------------------------
     ivf_summary = _ivf_leg(engine, sink, canned)
 
+    # -- leg 7: SLO burn-rate alert + flight recorder -------------------
+    slo_summary = _slo_leg(engine, workdir, canned)
+
     sink.close()
     summary = {
         "requests_sent": per_client * NUM_CLIENTS,
@@ -228,6 +254,7 @@ def run_smoke(workdir: str) -> dict:
         "buckets": list(engine.buckets),
         "ingest": ingest_summary,
         "ivf": ivf_summary,
+        "slo": slo_summary,
     }
     with open(os.path.join(workdir, "serve_smoke.json"), "w") as f:
         json.dump(summary, f, indent=2)
@@ -346,6 +373,106 @@ def _ivf_leg(engine, sink, canned) -> dict:
     }
 
 
+def _slo_leg(engine, workdir: str, canned) -> dict:
+    """Third server: request tracing on, tight SLO, short burn windows,
+    tightened burn threshold; a deterministic `slow@` fault injects the
+    tail. The acceptance bullet, executable: the slowed requests trip
+    the burn-rate alert and the flight dump attributes their latency to
+    exactly the slowed stage."""
+    import glob as globmod
+    import urllib.request
+
+    import numpy as np
+
+    from moco_tpu.obs.sinks import JsonlSink
+    from moco_tpu.serve.server import ServeServer
+    from moco_tpu.utils import faults
+
+    slo_dir = os.path.join(workdir, "slo_leg")
+    os.makedirs(slo_dir, exist_ok=True)
+    sink = JsonlSink(slo_dir)
+    server = ServeServer(
+        engine,
+        index=None,
+        port=0,
+        slo_ms=SLO_LEG_SLO_MS,
+        sink=sink,
+        metrics_flush_s=0.25,
+        warmup=False,  # the shared engine is already warm
+        workdir=slo_dir,
+        reqtrace=True,
+        slo_objective=0.9,
+        burn_windows=(30, 120),
+        alert_spec=(
+            "threshold@name=slo_burn_fast:field=serve/burn_rate_30s:value=1.0"
+        ),
+    )
+    base = f"http://127.0.0.1:{server.port}"
+
+    def post(imgs) -> dict:
+        req = urllib.request.Request(
+            base + "/embed",
+            data=imgs.tobytes(),
+            headers={"X-Image-Shape": ",".join(map(str, imgs.shape))},
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return json.loads(r.read())
+
+    imgs = canned[2]
+    slowed_ids: list[str] = []
+    try:
+        for _ in range(SLO_LEG_REQUESTS):  # healthy baseline
+            post(imgs)
+        # deterministic tail: the NEXT engine executions sleep; a fresh
+        # plan install resets the site counters so at=1 means "from the
+        # next call" regardless of warmup/baseline execution counts
+        faults.install(
+            f"slow@site=serve.engine_execute:ms={SLO_LEG_SLOW_MS:g}"
+            f":at=1:times={SLO_LEG_SLOWED}"
+        )
+        try:
+            for _ in range(SLO_LEG_SLOWED):
+                slowed_ids.append(post(imgs)["request_id"])
+        finally:
+            faults.clear()
+        for _ in range(6):  # post-incident traffic keeps the window live
+            post(imgs)
+        # give the flusher a turn: burn-rate computed, alert fired,
+        # flight dumped via the on_fire hook
+        deadline = time.time() + 10.0
+        while time.time() < deadline and not globmod.glob(
+            os.path.join(slo_dir, "flight_*.json")
+        ):
+            time.sleep(0.1)
+        with urllib.request.urlopen(base + "/debug/flight", timeout=30) as r:
+            debug_flight = json.loads(r.read())
+        stats = server.stats()
+        server._write_metrics()  # land the incident's gauges before close
+    finally:
+        server.close()
+        sink.close()
+    from moco_tpu.obs.alerts import read_alerts
+
+    alerts = read_alerts(os.path.join(slo_dir, "alerts.jsonl"))
+    dumps = sorted(globmod.glob(os.path.join(slo_dir, "flight_*.json")))
+    alert_dump = None
+    for path in dumps:
+        with open(path) as f:
+            rec = json.load(f)
+        if str(rec.get("reason", "")).startswith("alert:"):
+            alert_dump = rec
+    return {
+        "slo_ms": SLO_LEG_SLO_MS,
+        "slow_ms": SLO_LEG_SLOW_MS,
+        "slowed_ids": slowed_ids,
+        "alerts": alerts,
+        "dumps": [os.path.basename(p) for p in dumps],
+        "alert_dump": alert_dump,
+        "debug_flight": debug_flight,
+        "stats": stats,
+    }
+
+
 def assert_serve_surface(workdir: str, summary: dict) -> None:
     from moco_tpu.obs import schema
 
@@ -381,6 +508,70 @@ def assert_serve_surface(workdir: str, summary: dict) -> None:
     )
     assert istats["serve/p99_ms"] is not None and istats["serve/p99_ms"] <= SMOKE_SLO_MS
     assert istats["serve/nprobe"] == IVF_NPROBE and istats["serve/int8"] == 0, istats
+    # leg 7: the SLO-violation story end-to-end (ISSUE 10 acceptance):
+    # injected slow@serve.engine_execute -> burn-rate alert fired ->
+    # flight dump contains the slowed requests' waterfalls with the
+    # slowed stage correctly attributed
+    slo = summary["slo"]
+    assert any(a["rule"] == "slo_burn_fast" for a in slo["alerts"]), (
+        f"burn-rate alert never fired: {slo['alerts']}"
+    )
+    assert slo["slowed_ids"], "slowed requests carried no request ids"
+
+    def _assert_attributed(wf, rid):
+        stage_ms = {s["stage"]: s["dur_ms"] for s in wf["stages"]}
+        for stage in ("ingress", "queue_wait", "batch_assemble", "engine_execute",
+                      "scatter", "respond"):
+            assert stage in stage_ms, f"{rid}: stage {stage} missing: {stage_ms}"
+        worst = max(stage_ms, key=stage_ms.get)
+        assert worst == "engine_execute" and stage_ms[worst] >= slo["slow_ms"], (
+            f"{rid}: injected tail misattributed — {stage_ms}"
+        )
+
+    # the alert-edge dump already holds (at least) the first offender
+    # with the slowed stage attributed — the alert fires mid-incident
+    assert slo["alert_dump"] is not None, f"no alert-triggered flight dump: {slo['dumps']}"
+    alert_dumped = {r["request_id"]: r for r in slo["alert_dump"]["requests"]}
+    caught = [rid for rid in slo["slowed_ids"] if rid in alert_dumped]
+    assert caught, (
+        f"no slowed request in the alert dump: {sorted(alert_dumped)[-8:]}"
+    )
+    for rid in caught:
+        _assert_attributed(alert_dumped[rid], rid)
+    # the on-demand dump at the end holds the FULL incident
+    debug = slo["debug_flight"]
+    assert debug.get("dump_path"), "/debug/flight did not dump on demand"
+    debug_dumped = {r["request_id"]: r for r in debug["requests"]}
+    for rid in slo["slowed_ids"]:
+        assert rid in debug_dumped, f"slowed request {rid} missing from /debug/flight"
+        _assert_attributed(debug_dumped[rid], rid)
+    # the p99 exemplar names one of the offenders
+    sstats = slo["stats"]
+    assert sstats["serve/slo_violations"] >= len(slo["slowed_ids"]), sstats
+    assert any(
+        k.startswith("serve/burn_rate_") and sstats[k] is not None for k in sstats
+    ), f"no burn-rate gauge in stats: {sorted(sstats)}"
+    slowest = debug["slowest"][0]
+    assert slowest["request_id"] in slo["slowed_ids"], slowest
+    slo_metrics = os.path.join(workdir, "slo_leg", "metrics.jsonl")
+    errors = schema.validate_file(slo_metrics)
+    assert not errors, f"slo leg schema violations: {errors[:5]}"
+    slo_lines = schema.read_metrics(slo_metrics)
+    assert any(
+        r.get("serve/trace_engine_execute_ms") is not None for r in slo_lines
+    ), "no stage-trace means reached the sink"
+    assert any(r.get("event") == "alert" for r in slo_lines), (
+        "no in-band alert event line"
+    )
+    # the p99 exemplar on the incident's metrics lines blames an
+    # injected-slow request id — the gauge-to-request link, on the wire
+    assert any(
+        r.get("serve/p99_exemplar") in slo["slowed_ids"] for r in slo_lines
+    ), "no metrics line exemplar blames a slowed request"
+    # request spans reached the replica's Perfetto stream
+    assert os.path.exists(os.path.join(workdir, "slo_leg", "trace_events.s0.jsonl"))
+    assert os.path.exists(os.path.join(workdir, "slo_leg", "heartbeat.s0.json"))
+
     # metrics flushed through the sink are schema-strict
     metrics_path = os.path.join(workdir, "metrics.jsonl")
     assert os.path.exists(metrics_path), "server flushed no metrics.jsonl"
@@ -406,6 +597,7 @@ def main() -> int:
     assert_serve_surface(workdir, summary)
     s = summary["stats"]
     iv = summary["ivf"]["stats"]
+    slo = summary["slo"]
     print(
         f"serve smoke OK: {s['serve/requests']} requests, "
         f"p50={s['serve/p50_ms']:.1f}ms p99={s['serve/p99_ms']:.1f}ms "
@@ -416,7 +608,10 @@ def main() -> int:
         f"recall={iv['serve/recall_estimate']:.3f} "
         f"nprobe={iv['serve/nprobe']}/{IVF_NLIST} "
         f"p99={iv['serve/p99_ms']:.1f}ms "
-        f"recompiles={iv['serve/recompiles_after_warmup']} — "
+        f"recompiles={iv['serve/recompiles_after_warmup']} | "
+        f"slo leg: {len(slo['slowed_ids'])} slowed requests -> "
+        f"{len(slo['alerts'])} alert(s), {len(slo['dumps'])} flight dump(s), "
+        f"p99 exemplar {slo['stats'].get('serve/p99_exemplar')} — "
         f"artifacts in {workdir}"
     )
     return 0

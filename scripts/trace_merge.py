@@ -19,9 +19,19 @@ skews every track. This tool:
    `process_name` track label per host (hostname from the heartbeat
    when known), and every thread preserved.
 
-Open the output in https://ui.perfetto.dev — one track group per host.
-A process with no heartbeat (it died before its first beat, or a
-pre-fleet run) merges with zero offset and a warning in `otherData`.
+Serving replicas join the same timeline (PR 10): a `ServeServer`
+given a workdir streams its request spans (obs/reqtrace.py waterfalls
+on virtual "requests" lanes) to `trace_events.s<replica>.jsonl` with a
+`heartbeat.s<replica>.json` wall anchor. Those streams merge with
+pid = `SERVE_PID_BASE + replica` (offset so a serve replica co-hosted
+with training process 0 gets its own track group) against the SAME
+clock origin — so "the p99 request on replica 2" lines up under "step
+40 on host 0" and a balanced fleet stays debuggable.
+
+Open the output in https://ui.perfetto.dev — one track group per host
+plus one per serving replica. A process with no heartbeat (it died
+before its first beat, or a pre-fleet run) merges with zero offset and
+a warning in `otherData`.
 
 Needs only the stdlib + moco_tpu.obs (no jax), so it runs wherever the
 files were copied.
@@ -42,6 +52,11 @@ from moco_tpu.obs.fleet import read_heartbeats  # noqa: E402
 from moco_tpu.obs.trace import spans_to_chrome_events  # noqa: E402
 
 _PROC_RE = re.compile(r"trace_events\.p(\d+)\.jsonl$")
+_SERVE_RE = re.compile(r"trace_events\.s(\d+)\.jsonl$")
+
+# Serving-replica track-group offset: replica i renders as pid
+# SERVE_PID_BASE + i, clear of any plausible training host index.
+SERVE_PID_BASE = 100
 
 
 def discover_streams(workdir: str) -> dict[int, str]:
@@ -56,6 +71,32 @@ def discover_streams(workdir: str) -> dict[int, str]:
         if m:
             streams[int(m.group(1))] = path
     return streams
+
+
+def discover_serve_streams(workdir: str) -> dict[int, str]:
+    """{replica_index: span-stream path} for every serving replica's
+    `trace_events.s<i>.jsonl` under `workdir`."""
+    streams: dict[int, str] = {}
+    for path in glob.glob(os.path.join(workdir, "trace_events.s*.jsonl")):
+        m = _SERVE_RE.search(path)
+        if m:
+            streams[int(m.group(1))] = path
+    return streams
+
+
+def read_serve_anchors(workdir: str) -> dict[int, dict]:
+    """{replica_index: anchor record} from the per-replica
+    `heartbeat.s<i>.json` files ServeServer writes (same shape as the
+    fleet heartbeats, plus role="serve"); unparseable files skipped."""
+    out: dict[int, dict] = {}
+    for path in glob.glob(os.path.join(workdir, "heartbeat.s*.json")):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+            out[int(rec["process"])] = rec
+        except (ValueError, KeyError, OSError):
+            continue
+    return out
 
 
 def read_spans(path: str) -> list[dict]:
@@ -79,17 +120,27 @@ def merge_traces(workdir: str, output: str) -> dict:
     Chrome trace at `output`; returns a summary dict (process count,
     span counts, applied offsets)."""
     streams = discover_streams(workdir)
-    if not streams:
+    serve_streams = discover_serve_streams(workdir)
+    if not streams and not serve_streams:
         raise FileNotFoundError(f"no trace_events*.jsonl under {workdir}")
     beats = read_heartbeats(workdir)
+    serve_beats = read_serve_anchors(workdir)
     anchors = {
         p: rec["trace_wall_t0"]
         for p, rec in beats.items()
         if isinstance(rec.get("trace_wall_t0"), (int, float))
     }
-    origin = min(anchors.values()) if anchors else 0.0
+    serve_anchors = {
+        r: rec["trace_wall_t0"]
+        for r, rec in serve_beats.items()
+        if isinstance(rec.get("trace_wall_t0"), (int, float))
+    }
+    # ONE clock origin across training hosts AND serving replicas, so a
+    # request span lines up under the training step it rode alongside
+    all_anchors = list(anchors.values()) + list(serve_anchors.values())
+    origin = min(all_anchors) if all_anchors else 0.0
     events: list[dict] = []
-    summary = {"processes": {}, "unanchored": []}
+    summary = {"processes": {}, "serve_replicas": {}, "unanchored": []}
     for p in sorted(streams):
         spans = read_spans(streams[p])
         offset_us = (anchors[p] - origin) * 1e6 if p in anchors else 0.0
@@ -107,8 +158,29 @@ def merge_traces(workdir: str, output: str) -> dict:
             "offset_us": round(offset_us, 1),
             "host": host,
         }
+    for r in sorted(serve_streams):
+        spans = read_spans(serve_streams[r])
+        offset_us = (serve_anchors[r] - origin) * 1e6 if r in serve_anchors else 0.0
+        if r not in serve_anchors:
+            summary["unanchored"].append(f"s{r}")
+        host = serve_beats.get(r, {}).get("host")
+        name = f"serve replica {r}" + (f" ({host})" if host else "")
+        events.extend(
+            spans_to_chrome_events(
+                spans,
+                pid=SERVE_PID_BASE + r,
+                process_name=name,
+                ts_offset_us=offset_us,
+            )
+        )
+        summary["serve_replicas"][r] = {
+            "spans": len(spans),
+            "offset_us": round(offset_us, 1),
+            "host": host,
+        }
     meta = {
-        "merged_from": len(streams),
+        "merged_from": len(streams) + len(serve_streams),
+        "serve_replicas": sorted(serve_streams),
         "clock_origin_wall": origin,
         "unanchored_processes": summary["unanchored"],
     }
@@ -140,6 +212,12 @@ def main() -> int:
         print(
             f"process {p}: {info['spans']} spans, clock offset "
             f"{info['offset_us'] / 1e3:.1f} ms{host}"
+        )
+    for r, info in sorted(summary.get("serve_replicas", {}).items()):
+        host = f" host={info['host']}" if info["host"] else ""
+        print(
+            f"serve replica {r} (pid {SERVE_PID_BASE + r}): {info['spans']} "
+            f"spans, clock offset {info['offset_us'] / 1e3:.1f} ms{host}"
         )
     if summary["unanchored"]:
         print(
