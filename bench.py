@@ -536,11 +536,14 @@ def main() -> None:
                 os.environ.get("BENCH_SERVE_MEASURE_S", 3.0 if on_tpu else 8.0)
             )
 
-            def measure(reqtrace: bool):
-                """One closed-loop pass: fresh batcher + clients over the
-                shared warm engine; returns (qps/chip, final payload)."""
+            def measure(reqtrace: bool, run_batch_fn=None, warm=None, meas=None):
+                """One closed-loop pass: fresh batcher + clients over a
+                warm engine's run_batch; returns (qps/chip, payload)."""
+                run_batch_fn = run_batch_fn or run_batch
+                warm = warm_s if warm is None else warm
+                meas = measure_s if meas is None else meas
                 batcher = ContinuousBatcher(
-                    run_batch, max_batch=eng.buckets[-1], slo_ms=slo_ms,
+                    run_batch_fn, max_batch=eng.buckets[-1], slo_ms=slo_ms,
                     reqtrace=reqtrace,
                 )
                 measuring = threading.Event()
@@ -567,10 +570,10 @@ def main() -> None:
                 ]
                 for c in clients:
                     c.start()
-                time.sleep(warm_s)
+                time.sleep(warm)
                 measuring.set()
                 t0s = time.perf_counter()
-                time.sleep(measure_s)
+                time.sleep(meas)
                 measuring.clear()
                 dts = time.perf_counter() - t0s
                 stop_clients.set()
@@ -581,7 +584,7 @@ def main() -> None:
                 completed = sum(counts)
                 if completed == 0:
                     raise RuntimeError(
-                        f"no request completed inside the {measure_s}s measure "
+                        f"no request completed inside the {meas}s measure "
                         "window — raise BENCH_SERVE_MEASURE_S on very slow hosts"
                     )
                 return completed / dts / n_dev, payload
@@ -593,6 +596,107 @@ def main() -> None:
             qps_chip, payload = measure(reqtrace=False)
             qps_traced, payload_traced = measure(reqtrace=True)
             trace_overhead_pct = (qps_chip - qps_traced) / qps_chip * 100.0
+
+            # ---- quantized-engine A/B (ISSUE 11): w8 vs w8a8 ----------
+            # Same params, same buckets, same index; qps measured in
+            # short INTERLEAVED slices (the tiers alternate inside one
+            # wall window, so host drift hits both equally) plus each
+            # tier's embedding cosine vs the f32 engine on a fixed probe
+            # batch. `int8_kernels` records whether true int8×int8→int32
+            # actually ran (tpu/gpu) or the bit-faithful CPU emulation
+            # did (quant.py docstring: XLA:CPU has no int8 conv kernels,
+            # measured ~45x slower — so on the CPU smoke the w8a8-vs-w8
+            # speed signal is conv-bound ~parity and the arithmetic
+            # factor is an accelerator claim; the cosine floor gates
+            # everywhere).
+            quant_ab = None
+            if not os.environ.get("BENCH_SKIP_QUANT"):
+                probe = np.concatenate([canned[n] for n in sizes])
+                emb_f32, _ = eng.embed(probe)
+
+                def _mean_cos(a, b):  # rows are L2-normalized
+                    return float(np.mean(np.sum(
+                        np.asarray(a, np.float64) * np.asarray(b, np.float64),
+                        axis=-1,
+                    )))
+
+                calib_sample = np.concatenate([
+                    np.random.default_rng(50 + n).integers(
+                        0, 255, (n, img, img, 3), np.uint8
+                    )
+                    for n in sizes
+                ])
+                qengines = {}
+                for tier in ("w8", "w8a8"):
+                    kw = {"calib_sample": calib_sample} if tier == "w8a8" else {}
+                    qe = InferenceEngine(
+                        encoder,
+                        jax.device_get(state.params_k),
+                        jax.device_get(state.batch_stats_k),
+                        image_size=img,
+                        buckets=eng.buckets,
+                        engine_quant=tier,
+                        **kw,
+                    )
+                    qe.warmup()
+                    qengines[tier] = qe
+
+                def _quant_run_batch(qe):
+                    def rb(images, want_neighbors, *, stages=None):
+                        if want_neighbors and index is not None:
+                            emb, scores, nidx, executed = qe.embed_and_query(
+                                images, index, 5, stages=stages
+                            )
+                            return {
+                                "embedding": emb, "scores": scores, "indices": nidx,
+                            }, executed
+                        emb, executed = qe.embed(images, stages=stages)
+                        return {"embedding": emb}, executed
+                    return rb
+
+                slices = int(os.environ.get("BENCH_QUANT_SLICES", 3))
+                slice_s = float(
+                    os.environ.get("BENCH_QUANT_SLICE_S", max(measure_s / 3, 1.0))
+                )
+                acc = {t: [] for t in qengines}
+                for _ in range(slices):
+                    for tier, qe in qengines.items():
+                        q_t, _ = measure(
+                            reqtrace=False, run_batch_fn=_quant_run_batch(qe),
+                            warm=min(warm_s, 1.0), meas=slice_s,
+                        )
+                        acc[tier].append(q_t)
+                quant_ab = {}
+                for tier, qe in qengines.items():
+                    if qe.recompiles_after_warmup:
+                        raise RuntimeError(
+                            f"{tier} engine recompiled after warmup"
+                        )
+                    emb_q, _ = qe.embed(probe)
+                    audit = qe.donation_audit()
+                    quant_ab[tier] = {
+                        "qps": round(sum(acc[tier]) / len(acc[tier]), 2),
+                        "cosine_vs_f32": round(_mean_cos(emb_q, emb_f32), 5),
+                        "donation_audit_ok": not any(
+                            v is False for v in audit.values()
+                        ),
+                    }
+                quant_ab["w8a8"]["int8_kernels"] = bool(
+                    qengines["w8a8"].int8_compute
+                )
+                quant_ab["speedup_w8a8_vs_w8"] = round(
+                    quant_ab["w8a8"]["qps"] / quant_ab["w8"]["qps"], 3
+                )
+                print(
+                    f"serving quant A/B: w8={quant_ab['w8']['qps']:.1f} q/s "
+                    f"(cos={quant_ab['w8']['cosine_vs_f32']:.5f}) "
+                    f"w8a8={quant_ab['w8a8']['qps']:.1f} q/s "
+                    f"(cos={quant_ab['w8a8']['cosine_vs_f32']:.5f}, "
+                    f"int8_kernels={quant_ab['w8a8']['int8_kernels']}) "
+                    f"-> {quant_ab['speedup_w8a8_vs_w8']}x",
+                    file=sys.stderr,
+                )
+
             recompiles = eng.recompiles_after_warmup + (
                 index.recompiles_after_warmup if index is not None else 0
             )
@@ -634,6 +738,11 @@ def main() -> None:
                     for k, v in payload_traced.items()
                     if k.startswith("serve/trace_") and k.endswith("_ms")
                 },
+                # quantized-engine tiers (ISSUE 11): w8/w8a8 qps from the
+                # interleaved slices + embedding cosine vs f32 (gated at
+                # QUANT_COSINE_FLOOR by perf_ledger.py check), and
+                # whether true int8 kernels ran
+                "quant": quant_ab,
             }
             legs["serving"]["ran"] = True
             print(
@@ -701,7 +810,8 @@ def main() -> None:
             aidx.enable_int8()
             build_s = time.perf_counter() - t0a
             aidx.prepare([ann_m], k=max(ks), nprobe=ann_nprobe,
-                         modes=("exact", "ivf", "ivf_i8"))
+                         modes=("exact", "ivf", "ivf_i8",
+                                "ivf_fused", "ivf_fused_i8"))
             aidx.freeze()
 
             def _ann_leg(mode):
@@ -715,6 +825,11 @@ def main() -> None:
             exact_qps, exact_idx = _ann_leg("exact")
             ivf_qps, ivf_idx = _ann_leg("ivf")
             i8_qps, i8_idx = _ann_leg("ivf_i8")
+            # the fused gather-scan tiers (ISSUE 11): same probe/top-k
+            # semantics as the composed scan, one kernel, no
+            # (m, nprobe*cell_cap, d) candidate materialization
+            fused_qps, fused_idx = _ann_leg("ivf_fused")
+            fused_i8_qps, fused_i8_idx = _ann_leg("ivf_fused_i8")
             if aidx.recompiles_after_warmup:
                 raise RuntimeError(
                     f"ann leg recompiled {aidx.recompiles_after_warmup}x after freeze"
@@ -755,13 +870,34 @@ def main() -> None:
                     # reordering of near-ties)
                     "recall_at_10": _recall(i8_idx, exact_idx, 10),
                 },
+                # fused gather-scan tier (ISSUE 11): the composed scan's
+                # three hops as one kernel — recall-gated like every
+                # tier (perf_ledger check: recall floor + fused must
+                # beat the composed tier it replaces)
+                "fused": {
+                    "qps": round(fused_qps, 2),
+                    "speedup_vs_ivf": round(fused_qps / ivf_qps, 2),
+                    "recall_at_10": _recall(fused_idx, exact_idx, 10),
+                    # same candidate set by construction — ids match the
+                    # composed scan exactly on ties-free data
+                    "ids_match_composed": bool((fused_idx == ivf_idx).all()),
+                    "int8": {
+                        "qps": round(fused_i8_qps, 2),
+                        "speedup_vs_ivf_i8": round(fused_i8_qps / i8_qps, 2),
+                        "recall_at_10": _recall(fused_i8_idx, exact_idx, 10),
+                    },
+                },
             }
             legs["ann_ab"]["ran"] = True
             print(
                 f"ann A/B: K={ann_rows} exact={exact_qps:.1f} q/s "
                 f"ivf={ivf_qps:.1f} q/s ({ann_ab['speedup']}x, "
                 f"recall@10={ann_ab['recall_at_10']:.3f}) "
-                f"ivf_i8={i8_qps:.1f} q/s (build {build_s:.1f}s, "
+                f"ivf_i8={i8_qps:.1f} q/s | fused={fused_qps:.1f} q/s "
+                f"({ann_ab['fused']['speedup_vs_ivf']}x vs composed, "
+                f"recall@10={ann_ab['fused']['recall_at_10']:.3f}, "
+                f"ids_match={ann_ab['fused']['ids_match_composed']}) "
+                f"fused_i8={fused_i8_qps:.1f} q/s (build {build_s:.1f}s, "
                 f"spilled={stats['spilled']})",
                 file=sys.stderr,
             )

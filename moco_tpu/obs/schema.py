@@ -177,6 +177,15 @@ FIELD_VALIDATORS = {
     "serve/nprobe": lambda v: v is None or (_int_like(v) and v >= 1),
     "serve/int8": lambda v: v in (0, 1),
     "serve/ingested_rows": _int_like,
+    # raw-speed serving tiers (ISSUE 11): the engine quantization tier
+    # (0=off, 1=w8 weight-only, 2=w8a8 activation-quantized int8) and
+    # the IVF coarse-quantizer health gauges — rows the inverted file
+    # could not place (spill; the exact tier still serves them) and the
+    # mean cell fill over cell capacity. Both null until train_ivf runs;
+    # ROADMAP names them as the background re-fit trigger.
+    "serve/quant_tier": lambda v: v in (0, 1, 2),
+    "serve/ivf_spill": lambda v: v is None or (_int_like(v) and v >= 0),
+    "serve/ivf_occupancy": lambda v: v is None or (_num(v) and 0.0 <= v <= 1.0),
     # request-scoped serving observability (obs/reqtrace.py, obs/slo.py,
     # obs/flight.py — PR 10): the latency histogram the Prometheus sink
     # exposes with real cumulative buckets, the p99 exemplar linking the

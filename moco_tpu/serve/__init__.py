@@ -7,13 +7,21 @@ path (see each module's docstring):
 - `index`   the dictionary as a reusable store: shared FIFO-write +
             top-k-cosine kernels (core/queue.py and knn.py rehost on
             them) and the P(data)-shardable `EmbeddingIndex` with
-            AOT-bucketed queries in four tiers — exact, IVF (jitted
-            k-means cells, sub-linear probe scan), and int8 twins of
-            both (symmetric per-row quantized scoring)
+            AOT-bucketed queries in six tiers — exact, IVF (jitted
+            k-means cells, sub-linear probe scan), the FUSED IVF
+            gather-scan (one kernel, running top-k, no materialized
+            candidate gather; Pallas cell-DMA lowering on real chips),
+            and int8 twins (symmetric per-row quantized scoring)
 - `engine`  AOT-compiled (`jit().lower().compile()`) bf16 encoder
             inference, one executable per padded batch bucket
             {1, 8, 32, 128}, donation-audited, key (EMA) encoder by
-            default — the stable representation per arXiv:2307.13813
+            default — the stable representation per arXiv:2307.13813;
+            `engine_quant` selects off/w8/w8a8 quantization
+- `quant`   activation-quantized int8 (w8a8): calibration observer at
+            the preprocessing seam, symmetric scale fitting, the JSON
+            calibration artifact, and the int8×int8→int32 forward
+            (true int8 kernels on tpu/gpu; bit-faithful scaled-integer
+            emulation on CPU — the bf16 story, measured)
 - `batcher` continuous batching: micro-batch coalescing under a latency
             SLO (flush at max_batch or slo_ms/2), pad to the next
             bucket, scatter per-request; p50/p99/qps/occupancy metrics
@@ -37,6 +45,13 @@ _LAZY = {
     "load_serving_encoder": "engine",
     "quantize_params_int8": "engine",
     "dequantize_params": "engine",
+    "QUANT_MODES": "quant",
+    "ActivationObserver": "quant",
+    "calibrate_encoder": "quant",
+    "calibration_path": "quant",
+    "load_calibration": "quant",
+    "save_calibration": "quant",
+    "quantized_apply": "quant",
     "ContinuousBatcher": "batcher",
     "BatcherClosedError": "batcher",
     "ServeMetrics": "batcher",

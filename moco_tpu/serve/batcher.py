@@ -20,10 +20,12 @@ joins the batcher thread.
 
 Metrics (`ServeMetrics`): per-request latency reservoir → p50/p99,
 completed-request QPS, batch occupancy (valid rows / padded bucket
-rows — the padding tax), a per-bucket execution histogram, SLO
-violation counts, a cumulative latency histogram with the p99
-exemplar request id, per-stage request-trace means, and (when a
-`SLOBurnTracker` is attached) the multi-window burn-rate family.
+rows — the padding tax), a per-bucket execution histogram, per-tier
+request counts (`serve/mode_<tier>` — explicit `?mode=` riders under
+their tier, the rest under "default"), SLO violation counts, a
+cumulative latency histogram with the p99 exemplar request id,
+per-stage request-trace means, and (when a `SLOBurnTracker` is
+attached) the multi-window burn-rate family.
 `payload()` emits the `serve/*` metric family the obs schema validates
 and the Prometheus sink exposes as gauges + a real
 `_bucket{le=...}` histogram.
@@ -149,6 +151,12 @@ class ServeMetrics:
         self._hist_sum_ms = 0.0
         self._hist_count = 0
         self._exemplar: Optional[tuple[float, str]] = None  # (ms, request_id)
+        # per-tier request counts (serve/mode_<tier>): which retrieval
+        # mode answered the traffic — explicit ?mode= riders under their
+        # tier name, everything else under "default" (the server's
+        # neighbors_mode). The tier A/B and the fleet router both read
+        # this to see where load actually lands.
+        self._mode_counts: dict[str, int] = {}
         # per-stage request-trace sums over the current payload window
         self._stage_sums_ms: dict[str, float] = {}
         self._stage_reqs = 0
@@ -165,9 +173,12 @@ class ServeMetrics:
         latency_s: float,
         request_id: Optional[str] = None,
         trace: Optional[RequestTrace] = None,
+        mode: Optional[str] = None,
     ) -> None:
         ms = latency_s * 1e3
         with self._lock:
+            key = mode or "default"
+            self._mode_counts[key] = self._mode_counts.get(key, 0) + 1
             self._latencies_ms.append(ms)
             self._completed += 1
             self._win_completed += 1
@@ -265,6 +276,9 @@ class ServeMetrics:
             self._stage_reqs = 0
             for bucket, count in sorted(self._bucket_counts.items()):
                 out[f"serve/bucket_{bucket}"] = count
+            # cumulative per-tier counts, like the bucket histogram
+            for m, count in sorted(self._mode_counts.items()):
+                out[f"serve/mode_{m}"] = count
         if self.burn is not None:
             out.update(self.burn.payload())
         return out
@@ -430,6 +444,7 @@ class ContinuousBatcher:
                 fut.latency_s,
                 request_id=fut.trace.req_id if fut.trace is not None else None,
                 trace=fut.trace,
+                mode=fut.mode,
             )
 
     def _loop(self) -> None:

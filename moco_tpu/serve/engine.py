@@ -15,12 +15,28 @@ the serve smoke asserts at zero across mixed request sizes.
 Graph: uint8 images → /255 → per-channel normalize (the eval recipe
 `knn.extract_features` uses) → module forward in bf16 (the serving
 default — inference tolerates bf16 activations; params stay f32) →
-f32 cast → L2-normalize. `int8=True` adds weight-only post-training
-quantization at this same seam: the encoder's matmul/conv kernels are
-stored int8 (symmetric per-output-channel, :func:`quantize_params_int8`)
-and dequantized inside each bucket's executable, with the quantized
-trees passed as call arguments so the at-rest saving survives XLA
-constant folding. The module is whatever representation the
+f32 cast → L2-normalize. `engine_quant` selects the quantization tier
+at this same seam (`int8=True` is the back-compat spelling of "w8"):
+
+- **w8** — weight-only PTQ: the encoder's matmul/conv kernels are
+  stored int8 (symmetric per-output-channel,
+  :func:`quantize_params_int8`) and dequantized inside each bucket's
+  executable; matmuls still run f32. ~4x at-rest param memory.
+- **w8a8** — activation-quantized int8 end-to-end (serve/quant.py):
+  a calibration artifact (per-tensor activation ranges from a held-out
+  sample run through the f32 encoder at this exact preprocessing seam)
+  supplies symmetric input scales, and every plain conv/dense runs
+  int8×int8→int32 (`preferred_element_type=jnp.int32`) with one f32
+  rescale at the layer boundary. True int8 kernels are tpu/gpu-only;
+  CPU runs the bit-faithful scaled-integer emulation (quant.py module
+  docstring — the bf16 story again), so cosine/recall are testable on
+  the CPU smoke while the arithmetic factor is an accelerator claim.
+
+All quantized trees (int8 params, weight scales, activation scales)
+are passed as call ARGUMENTS to the per-bucket executables, never
+closure constants — XLA would constant-fold `int8 · scale` straight
+back into f32 constants and silently undo the at-rest saving. The
+module is whatever representation the
 deployment serves: the FULL encoder (backbone + projection head, the
 `load_serving_encoder` default) embeds into the negative queue's space
 so the index can hold the trained dictionary, while a bare backbone
@@ -28,7 +44,13 @@ serves kNN-style features. Input buffers are donated on backends with
 donation support and the donation is *audited*: :meth:`donation_audit`
 verifies post-hoc that each bucket's input buffer was actually consumed
 (deleted) by its call, so a silent donation regression (e.g. a wrapper
-holding a reference) shows up as a boolean, not a slow leak.
+holding a reference) shows up as a boolean, not a slow leak. On the
+quantized tiers the audit extends to the quantized parameter trees:
+the donated input must still be consumed per bucket exactly as on the
+f32 path, while the int8 param/scale trees — reused by every later
+call — must SURVIVE it (`qtree:<bucket>` audit entries; an accidental
+donation there would be a use-after-free on the next request, and
+serve_smoke fails loudly on any False entry).
 
 Encoder side: the *key* (EMA) encoder by default — serving wants the
 slow-moving stable representation ("How to Scale Your EMA",
@@ -161,7 +183,13 @@ class InferenceEngine:
         buckets: Sequence[int] = DEFAULT_BUCKETS,
         donate: Optional[bool] = None,
         int8: bool = False,
+        engine_quant: Optional[str] = None,
+        calibration: Optional[dict] = None,
+        calib_sample: Optional[np.ndarray] = None,
+        int8_compute: Optional[bool] = None,
     ):
+        from moco_tpu.serve import quant as quant_mod
+
         if not buckets or sorted(set(int(b) for b in buckets)) != sorted(
             int(b) for b in buckets
         ):
@@ -174,15 +202,32 @@ class InferenceEngine:
             # buffer) — same backend gate as make_train_step's donate_nums
             donate = jax.default_backend() in ("tpu", "gpu")
         self.donate = bool(donate)
-        self.int8 = bool(int8)
+        # tier resolution: engine_quant wins; int8=True is the PR-9
+        # spelling of "w8" (kept so existing callers/tests read the same)
+        if engine_quant is None:
+            engine_quant = "w8" if int8 else "off"
+        if engine_quant not in quant_mod.QUANT_MODES:
+            raise ValueError(
+                f"engine_quant must be one of {quant_mod.QUANT_MODES}, "
+                f"got {engine_quant!r}"
+            )
+        self.quant = engine_quant
+        self.int8 = engine_quant != "off"  # back-compat gauge (serve/int8)
         self._variables = {"params": params, "batch_stats": batch_stats}
         self._qparams = self._qscales = None
+        self._act_scales = None
+        self.calibration: Optional[dict] = None
+        # true int8 kernels only where the backend has them (quant.py
+        # docstring: XLA:CPU emulates, measured ~45x — the bf16 story)
+        self.int8_compute = (
+            quant_mod.default_int8_compute() if int8_compute is None else bool(int8_compute)
+        )
 
         from moco_tpu.data.augment import get_recipe, normalize
 
         recipe = get_recipe(False, self.image_size)
 
-        if self.int8:
+        if self.quant != "off":
             # PTQ slots into the same per-bucket AOT seam: the forward
             # takes the quantized trees as ARGUMENTS (quantize_params_int8
             # docstring explains why a closure constant would constant-fold
@@ -190,6 +235,36 @@ class InferenceEngine:
             self._qparams, self._qscales = quantize_params_int8(params)
             self._qparams = jax.device_put(self._qparams)
             self._qscales = jax.device_put(self._qscales)
+
+        if self.quant == "w8a8":
+            # calibration: an explicit artifact wins; else fit one from
+            # the held-out sample at this exact preprocessing seam
+            if calibration is None:
+                if calib_sample is None:
+                    raise ValueError(
+                        "engine_quant='w8a8' needs a calibration artifact "
+                        "(calibration=...) or a held-out sample (calib_sample=...)"
+                    )
+                calibration = quant_mod.calibrate_encoder(
+                    module, params, batch_stats, calib_sample, self.image_size
+                )
+            quant_mod.validate_calibration(calibration, params, self.image_size)
+            self.calibration = calibration
+            self._act_scales = jax.device_put(
+                quant_mod.activation_scales(calibration)
+            )
+            int8_compute_flag = self.int8_compute
+
+            def forward(raw, qparams, qscales, act_scales):  # (b,H,W,C) uint8
+                x = raw.astype(jnp.float32) / 255.0
+                x = normalize(x, recipe.mean, recipe.std)
+                feats = quant_mod.quantized_apply(
+                    module, qparams, qscales, batch_stats, act_scales, x,
+                    int8_compute=int8_compute_flag,
+                )
+                return l2_normalize(feats.astype(jnp.float32))
+
+        elif self.quant == "w8":
 
             def forward(raw, qparams, qscales):  # (b, H, W, C) uint8
                 x = raw.astype(jnp.float32) / 255.0
@@ -214,9 +289,18 @@ class InferenceEngine:
         self._frozen = False
         self.aot_compiles = 0
         self._warm_compiles: Optional[int] = None
-        self._donation_audit: dict[int, Optional[bool]] = {}
+        self._donation_audit: dict = {}
         for b in self.buckets:
             self._compile(b)
+
+    def _quant_args(self) -> tuple:
+        """The quantized trees each executable takes as arguments —
+        () / (qparams, qscales) / (qparams, qscales, act_scales)."""
+        if self.quant == "w8a8":
+            return (self._qparams, self._qscales, self._act_scales)
+        if self.quant == "w8":
+            return (self._qparams, self._qscales)
+        return ()
 
     # -- compilation -----------------------------------------------------
 
@@ -233,8 +317,8 @@ class InferenceEngine:
         shape = jax.ShapeDtypeStruct(
             (bucket, self.image_size, self.image_size, 3), jnp.uint8
         )
-        args = (shape,) if not self.int8 else (shape, self._qparams, self._qscales)
-        with obs_span("serve_aot_compile", bucket=bucket):
+        args = (shape,) + self._quant_args()
+        with obs_span("serve_aot_compile", bucket=bucket, quant=self.quant):
             compiled = jitted.lower(*args).compile()
         self.aot_compiles += 1
         self._compiled[bucket] = compiled
@@ -264,12 +348,19 @@ class InferenceEngine:
             return 0
         return self.aot_compiles - self._warm_compiles
 
-    def donation_audit(self) -> dict[int, Optional[bool]]:
+    def donation_audit(self) -> dict:
         """Per-bucket: True = the donated input buffer was consumed by
         the call (deleted — donation is real), False = donation was
         requested but the buffer survived (a reference leak would
         double peak memory per request), None = donation disabled
-        (backend without support). Populated lazily as buckets run."""
+        (backend without support). Populated lazily as buckets run.
+
+        Quantized tiers add `"qtree:<bucket>"` entries auditing the
+        quantized parameter trees (int8 params + scales + activation
+        scales): True = every tree buffer SURVIVED the call (they are
+        reused by every later request; an accidental donation would be
+        a use-after-free on the next one), False = some buffer was
+        consumed. serve_smoke fails loudly on any False in the map."""
         return dict(self._donation_audit)
 
     # -- execution -------------------------------------------------------
@@ -292,17 +383,23 @@ class InferenceEngine:
         if compiled is None:
             compiled = self._compile(bucket)
         staged = jax.device_put(jnp.asarray(padded, jnp.uint8))
-        out = (
-            compiled(staged)
-            if not self.int8
-            else compiled(staged, self._qparams, self._qscales)
-        )
+        quant_args = self._quant_args()
+        out = compiled(staged, *quant_args)
         if bucket not in self._donation_audit:
             if self.donate:
                 out.block_until_ready()
                 self._donation_audit[bucket] = bool(staged.is_deleted())
             else:
                 self._donation_audit[bucket] = None
+            if quant_args:
+                # the quantized trees are call arguments on EVERY bucket
+                # execution — they must all survive (donation_audit
+                # docstring); checked once per bucket like the input
+                out.block_until_ready()
+                self._donation_audit[f"qtree:{bucket}"] = not any(
+                    getattr(leaf, "is_deleted", lambda: False)()
+                    for leaf in jax.tree_util.tree_leaves(quant_args)
+                )
         return out
 
     def _padded_chunks(self, images: np.ndarray):

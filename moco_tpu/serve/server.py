@@ -501,10 +501,25 @@ class ServeServer:
         out["serve/int8"] = int(
             self.neighbors_mode.endswith("_i8") or getattr(self.engine, "int8", False)
         )
+        # engine quantization tier as a scraped gauge: 0=off, 1=w8
+        # (weight-only PTQ), 2=w8a8 (activation-quantized int8)
+        out["serve/quant_tier"] = {"off": 0, "w8": 1, "w8a8": 2}.get(
+            getattr(self.engine, "quant", "off"), 0
+        )
         if self.index is not None:
             out["serve/index_rows"] = self.index.count
             out["serve/ingested_rows"] = self.ingested_rows
             out["serve/recompiles_after_warmup"] += self.index.recompiles_after_warmup
+            # coarse-quantizer health (ROADMAP's future re-fit trigger):
+            # rows the IVF could not place (served exactly instead) and
+            # mean cell fill — null until train_ivf has run
+            ivf_stats = self.index.ivf_stats()
+            out["serve/ivf_spill"] = (
+                ivf_stats["spilled"] if ivf_stats.get("trained") else None
+            )
+            out["serve/ivf_occupancy"] = (
+                ivf_stats["occupancy"] if ivf_stats.get("trained") else None
+            )
         return out
 
     def _flush_loop(self, interval: float) -> None:

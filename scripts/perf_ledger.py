@@ -39,8 +39,25 @@ DEFAULT_LEDGER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", 
 ACCEL_THRESHOLD = 0.10
 CPU_SMOKE_THRESHOLD = 0.50
 # recall@10 floor for the ANN series (CONTRIBUTING: the review gate) —
-# qps wins bought by recall losses fail the build
+# qps wins bought by recall losses fail the build. Applies to EVERY
+# approximate tier in the record (composed IVF and the fused scan).
 ANN_RECALL_FLOOR = 0.95
+# embedding-cosine floor for the quantized engine tiers (w8/w8a8 vs the
+# f32 engine on the same probe batch): quantization that moves the
+# embedding space does not merge, on any platform
+QUANT_COSINE_FLOOR = 0.99
+# tier-vs-tier speed gates (ISSUE 11): a new tier must beat the tier it
+# replaces — the fused scan vs the composed scan, w8a8 vs w8. On
+# accelerator metrics the ratio is a hard >= 1.0 (the tier exists to be
+# faster); the CPU smoke gets slack for two reasons the repo has
+# already measured: shared-runner jitter (the 0.50 regression
+# threshold's reason), and for w8a8 specifically the absence of int8
+# conv kernels in XLA:CPU (~45x slower than f32, so the CPU path runs
+# the bit-faithful f32 emulation and the arithmetic win only exists on
+# a chip — serve/quant.py docstring). The ratios are measured within
+# ONE bench record (interleaved slices), so no cross-run drift applies.
+TIER_MIN_RATIO_ACCEL = 1.0
+TIER_MIN_RATIO_CPU = 0.75
 # request-tracing overhead caps for the serving series (ISSUE 10
 # acceptance: tracing ON must cost < 5% qps). The CPU smoke gets the
 # same widened treatment as its regression threshold — the two
@@ -162,6 +179,33 @@ def check(ledger_path: str, input_path: str, threshold: float | None = None) -> 
     ledger = load_ledger(ledger_path)
     rec = load_bench_record(input_path)
     rc = _gate_series(ledger, rec["metric"], rec.get("value"), threshold, lambda e: e)
+
+    def _tier_ratio_gate(metric: str, name: str, new_qps, old_qps) -> int:
+        """In-record tier gate: the new tier's qps vs the tier it
+        replaces, platform-appropriate minimum ratio (constants above)."""
+        if new_qps is None or not old_qps:
+            return 0
+        floor_ratio = (
+            TIER_MIN_RATIO_CPU if "cpu_smoke" in metric else TIER_MIN_RATIO_ACCEL
+        )
+        ratio = new_qps / old_qps
+        verdict = "PASS" if ratio >= floor_ratio else "FAIL"
+        print(
+            f"perf gate [{verdict}] {metric}: {name} {ratio:.2f}x "
+            f"(floor {floor_ratio:g}x)"
+        )
+        return 0 if verdict == "PASS" else 1
+
+    def _floor_gate(metric: str, name: str, value, floor: float) -> int:
+        if value is None:
+            return 0
+        verdict = "PASS" if value >= floor else "FAIL"
+        print(
+            f"perf gate [{verdict}] {metric}: {name} {value:.4f} "
+            f"(floor {floor:g})"
+        )
+        return 0 if verdict == "PASS" else 1
+
     serving = rec.get("serving")
     if serving and serving.get("metric"):
         rc |= _gate_series(
@@ -191,6 +235,26 @@ def check(ledger_path: str, input_path: str, threshold: float | None = None) -> 
                     f"perf gate [PASS] {serving['metric']}: request-tracing "
                     f"overhead {overhead:.1f}% (cap {cap:g}%)"
                 )
+        # quantized-engine tiers (ISSUE 11): both tiers must hold the
+        # embedding-cosine floor vs f32 (hard, every platform — speed
+        # bought by moving the embedding space is a regression), and
+        # w8a8 must beat w8 at the platform ratio (see constants: the
+        # arithmetic factor is an accelerator claim; the CPU smoke
+        # gates against catastrophic slowdowns only)
+        quant = serving.get("quant") or {}
+        for tier in ("w8", "w8a8"):
+            rc |= _floor_gate(
+                serving["metric"],
+                f"{tier} cosine_vs_f32",
+                (quant.get(tier) or {}).get("cosine_vs_f32"),
+                QUANT_COSINE_FLOOR,
+            )
+        rc |= _tier_ratio_gate(
+            serving["metric"],
+            "w8a8 qps vs w8",
+            (quant.get("w8a8") or {}).get("qps"),
+            (quant.get("w8") or {}).get("qps"),
+        )
     # third gated series since the IVF tier: approximate-NN queries/s
     # (the sub-linear retrieval headline) — same most-recent-comparable
     # rule; additionally a recall@10 FLOOR (an ANN index that got fast
@@ -208,6 +272,18 @@ def check(ledger_path: str, input_path: str, threshold: float | None = None) -> 
                 f"below the {ANN_RECALL_FLOOR} floor"
             )
             rc |= 1
+        # fused gather-scan tier (ISSUE 11): recall floor like every
+        # approximate tier, plus the in-record ratio gate — the fused
+        # kernel exists to beat the composed scan it replaces
+        fused = ann.get("fused") or {}
+        rc |= _floor_gate(
+            ann["metric"], "fused recall@10",
+            fused.get("recall_at_10"), ANN_RECALL_FLOOR,
+        )
+        rc |= _tier_ratio_gate(
+            ann["metric"], "fused qps vs composed ivf",
+            fused.get("qps"), ann.get("value"),
+        )
     # informational deltas for the secondary series (never gating —
     # they gate the day they prove stable enough)
     baseline = None

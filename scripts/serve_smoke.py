@@ -39,7 +39,20 @@ The story (the ISSUE-8 acceptance bullet, executable):
    `slo_leg/`, a CI artifact), the dump contains the slowed requests'
    full stage waterfalls with `engine_execute` correctly dominating,
    `/debug/flight` answers on demand, and the flushed
-   `serve/burn_rate_*` + `serve/trace_*` lines are schema-strict.
+   `serve/burn_rate_*` + `serve/trace_*` lines are schema-strict;
+8. the W8A8 + FUSED-IVF leg (ISSUE 11): activation ranges are
+   calibrated from a held-out sample at the checkpoint, the artifact
+   round-trips through disk (`quant_calib.json` next to the
+   checkpoint), a `engine_quant="w8a8"` engine boots serving
+   `/neighbors` through the FUSED IVF gather-scan
+   (`neighbors_mode="ivf_fused"`, recall sampled on every flush) —
+   asserts ZERO recompiles after warmup across the new (mode, quant)
+   bucket keys, embedding cosine ≥ 0.99 vs the f32 engine, the online
+   recall estimate at the floor, p99 ≤ the smoke SLO, the donation
+   audit clean on the quantized trees (no False — a consumed qtree
+   buffer would be a use-after-free on the next request), and the
+   `serve/quant_tier`/`serve/ivf_spill`/`serve/ivf_occupancy` gauges
+   schema-strict.
 
 CI runs this in the tier-1 job and uploads the workdir (metrics.jsonl +
 serve_smoke.json summary + the SLO leg's flight dump) as an artifact.
@@ -100,6 +113,12 @@ SLO_LEG_SLO_MS = float(os.environ.get("SERVE_SMOKE_SLO_LEG_SLO_MS", 800.0))
 SLO_LEG_SLOW_MS = 3.0 * SLO_LEG_SLO_MS
 SLO_LEG_REQUESTS = 12
 SLO_LEG_SLOWED = 4
+# W8A8 + fused-IVF leg (ISSUE 11): calibration sample size, request
+# count, and the cosine floor the quantized embeddings must hold vs the
+# f32 engine (the same floor perf_ledger gates on the bench record)
+QUANT_CALIB_SAMPLES = 32
+QUANT_REQUESTS = 40
+QUANT_COSINE_FLOOR = float(os.environ.get("SERVE_SMOKE_QUANT_COSINE_FLOOR", 0.99))
 
 
 def make_toy_checkpoint(workdir: str):
@@ -244,6 +263,9 @@ def run_smoke(workdir: str) -> dict:
     # -- leg 7: SLO burn-rate alert + flight recorder -------------------
     slo_summary = _slo_leg(engine, workdir, canned)
 
+    # -- leg 8: w8a8 engine + fused IVF scan ----------------------------
+    quant_summary = _quant_leg(ckpt_dir, engine, sink, canned)
+
     sink.close()
     summary = {
         "requests_sent": per_client * NUM_CLIENTS,
@@ -255,6 +277,7 @@ def run_smoke(workdir: str) -> dict:
         "ingest": ingest_summary,
         "ivf": ivf_summary,
         "slo": slo_summary,
+        "quant": quant_summary,
     }
     with open(os.path.join(workdir, "serve_smoke.json"), "w") as f:
         json.dump(summary, f, indent=2)
@@ -473,6 +496,103 @@ def _slo_leg(engine, workdir: str, canned) -> dict:
     }
 
 
+def _quant_leg(ckpt_dir: str, engine_f32, sink, canned) -> dict:
+    """Fourth server: the w8a8 engine behind the fused IVF scan
+    (module docstring leg 8). Calibration is captured from a held-out
+    sample at the checkpoint, saved as `quant_calib.json` NEXT TO the
+    checkpoint, and loaded back through disk — the exact boot path a
+    production replica takes — before the quantized engine compiles its
+    buckets. Traffic mixes explicit `?mode=ivf_fused` riders with the
+    server default; recall samples on every flush."""
+    import numpy as np
+
+    from moco_tpu.serve import quant
+    from moco_tpu.serve.engine import InferenceEngine, load_serving_encoder
+    from moco_tpu.serve.index import EmbeddingIndex
+    from moco_tpu.serve.server import ServeServer
+
+    module, params, stats, _queue, _ptr, _config = load_serving_encoder(ckpt_dir)
+    rng = np.random.default_rng(11)
+    sample = rng.integers(
+        0, 255, (QUANT_CALIB_SAMPLES, IMAGE_SIZE, IMAGE_SIZE, 3), np.uint8
+    )
+    calib = quant.calibrate_encoder(module, params, stats, sample, IMAGE_SIZE)
+    calib_path = quant.save_calibration(ckpt_dir, calib)
+    loaded = quant.load_calibration(ckpt_dir)
+    engine = InferenceEngine(
+        module, params, stats,
+        image_size=IMAGE_SIZE, buckets=(1, 8, 32),
+        engine_quant="w8a8", calibration=loaded,
+    )
+    # quantized embeddings must stay in the f32 engine's space
+    probe = canned[16]
+    emb_q, _ = engine.embed(probe)
+    emb_f, _ = engine_f32.embed(probe)
+    cosine = float(np.mean(np.sum(
+        emb_q.astype(np.float64) * emb_f.astype(np.float64), axis=-1
+    )))
+    # clustered dictionary, served through the fused scan
+    dim = engine.num_features or 16
+    per = IVF_DICT_ROWS // IVF_NLIST
+    centers = rng.normal(size=(IVF_NLIST, dim)).astype(np.float32)
+    rows = np.repeat(centers, per, axis=0) + 0.2 * rng.normal(
+        size=(IVF_DICT_ROWS, dim)
+    ).astype(np.float32)
+    rows /= np.linalg.norm(rows, axis=1, keepdims=True)
+    index = EmbeddingIndex(IVF_DICT_ROWS, dim)
+    index.snapshot(rows)
+    index.train_ivf(nlist=IVF_NLIST, nprobe=IVF_NPROBE)
+    server = ServeServer(
+        engine,
+        index=index,
+        port=0,
+        slo_ms=SERVER_SLO_MS,
+        neighbors_k=5,
+        neighbors_mode="ivf_fused",
+        nprobe=IVF_NPROBE,
+        recall_sample_every=1,
+        sink=sink,
+        metrics_flush_s=0.5,
+    )
+    base = f"http://127.0.0.1:{server.port}"
+    failures: list[str] = []
+    try:
+        for j in range(QUANT_REQUESTS):
+            n = int(rng.choice(REQUEST_SIZES))
+            imgs = canned[n]
+            path = "/neighbors?k=5&mode=ivf_fused" if j % 3 else "/neighbors?k=5"
+            req = urllib.request.Request(
+                base + path,
+                data=imgs.tobytes(),
+                headers={"X-Image-Shape": ",".join(map(str, imgs.shape))},
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=60) as r:
+                    out = json.loads(r.read())
+                idx = np.asarray(out["indices"])
+                if out.get("mode") != "ivf_fused" or idx.shape != (n, 5) or (
+                    idx >= IVF_DICT_ROWS
+                ).any():
+                    failures.append(f"quant req {j}: malformed {out.get('mode')}")
+            except Exception as e:
+                failures.append(f"quant req {j}: {e!r}")
+        stats_out = server.stats()
+    finally:
+        server.close()
+    return {
+        "failures": failures,
+        "stats": stats_out,
+        "cosine_vs_f32": cosine,
+        "cosine_floor": QUANT_COSINE_FLOOR,
+        "calib_path": os.path.basename(calib_path),
+        "calib_layers": calib["num_layers"],
+        "calib_roundtrip": loaded == calib,
+        "recall_floor": RECALL_FLOOR,
+        "donation_audit": {str(k): v for k, v in engine.donation_audit().items()},
+        "ivf_stats": index.ivf_stats(),
+    }
+
+
 def assert_serve_surface(workdir: str, summary: dict) -> None:
     from moco_tpu.obs import schema
 
@@ -572,6 +692,38 @@ def assert_serve_surface(workdir: str, summary: dict) -> None:
     assert os.path.exists(os.path.join(workdir, "slo_leg", "trace_events.s0.jsonl"))
     assert os.path.exists(os.path.join(workdir, "slo_leg", "heartbeat.s0.json"))
 
+    # leg 8: the w8a8 engine behind the fused IVF scan (ISSUE 11) —
+    # zero recompiles across the new (mode, quant) bucket keys, the
+    # quantized embeddings pinned to the f32 space, the recall floor
+    # held through the fused tier, and the donation audit clean on the
+    # quantized trees (fail LOUDLY on any False: a consumed qtree
+    # buffer is a use-after-free on the next request)
+    qleg = summary["quant"]
+    assert not qleg["failures"], f"quant request failures: {qleg['failures'][:5]}"
+    assert qleg["calib_roundtrip"], "calibration artifact did not roundtrip"
+    assert qleg["cosine_vs_f32"] >= qleg["cosine_floor"], (
+        f"w8a8 cosine {qleg['cosine_vs_f32']:.5f} below the "
+        f"{qleg['cosine_floor']} floor"
+    )
+    qstats = qleg["stats"]
+    assert qstats["serve/recompiles_after_warmup"] == 0, qstats
+    assert qstats["serve/quant_tier"] == 2, qstats
+    assert qstats["serve/recall_estimate"] is not None, qstats
+    assert qstats["serve/recall_estimate"] >= qleg["recall_floor"], (
+        f"fused-tier online recall {qstats['serve/recall_estimate']} below "
+        f"the {qleg['recall_floor']} floor under the w8a8 engine"
+    )
+    assert qstats["serve/p99_ms"] is not None and qstats["serve/p99_ms"] <= SMOKE_SLO_MS
+    # ivf_stats exported: spill + occupancy gauges (the re-fit trigger)
+    assert qstats["serve/ivf_spill"] is not None and qstats["serve/ivf_spill"] >= 0
+    assert qstats["serve/ivf_occupancy"] is not None and 0 < qstats["serve/ivf_occupancy"] <= 1
+    bad_audit = {k: v for k, v in qleg["donation_audit"].items() if v is False}
+    assert not bad_audit, (
+        f"donation audit failed on the quantized engine: {bad_audit} — "
+        "a donated-but-surviving input leaks memory per request; a "
+        "consumed quantized tree is a use-after-free on the next one"
+    )
+
     # metrics flushed through the sink are schema-strict
     metrics_path = os.path.join(workdir, "metrics.jsonl")
     assert os.path.exists(metrics_path), "server flushed no metrics.jsonl"
@@ -611,7 +763,11 @@ def main() -> int:
         f"recompiles={iv['serve/recompiles_after_warmup']} | "
         f"slo leg: {len(slo['slowed_ids'])} slowed requests -> "
         f"{len(slo['alerts'])} alert(s), {len(slo['dumps'])} flight dump(s), "
-        f"p99 exemplar {slo['stats'].get('serve/p99_exemplar')} — "
+        f"p99 exemplar {slo['stats'].get('serve/p99_exemplar')} | "
+        f"quant leg: w8a8 cos={summary['quant']['cosine_vs_f32']:.5f} "
+        f"fused recall={summary['quant']['stats']['serve/recall_estimate']:.3f} "
+        f"recompiles={summary['quant']['stats']['serve/recompiles_after_warmup']} "
+        f"spill={summary['quant']['stats']['serve/ivf_spill']} — "
         f"artifacts in {workdir}"
     )
     return 0

@@ -92,3 +92,71 @@ def test_value_none_is_not_gated(tmp_path):
     ledger_mod.append(ledger, _write(tmp_path / "b1.json", BENCH_TPU), "r10")
     cand = {"metric": BENCH_TPU["metric"], "value": None}
     assert ledger_mod.check(ledger, _write(tmp_path / "n.json", cand)) == 0
+
+
+# -- ISSUE 11 tier gates: fused IVF + quantized engine --------------------
+
+ANN_CPU = {
+    "metric": "moco_v1_r18_cpu_smoke_imgs_per_sec",
+    "value": 10.0,
+    "ann_ab": {
+        "metric": "moco_ann_ivf_cpu_smoke_queries_per_sec",
+        "value": 300.0,
+        "recall_at_10": 1.0,
+        "fused": {"qps": 900.0, "recall_at_10": 1.0},
+    },
+}
+
+
+def test_fused_tier_gates(tmp_path):
+    ledger = str(tmp_path / "ledger.json")
+    # fused beats composed at full recall: pass
+    assert ledger_mod.check(ledger, _write(tmp_path / "a1.json", ANN_CPU)) == 0
+    # fused recall below the floor: fail (recall-gated like every tier)
+    bad = json.loads(json.dumps(ANN_CPU))
+    bad["ann_ab"]["fused"]["recall_at_10"] = 0.90
+    assert ledger_mod.check(ledger, _write(tmp_path / "a2.json", bad)) == 1
+    # fused slower than 0.75x composed on the cpu smoke: fail
+    slow = json.loads(json.dumps(ANN_CPU))
+    slow["ann_ab"]["fused"]["qps"] = 200.0
+    assert ledger_mod.check(ledger, _write(tmp_path / "a3.json", slow)) == 1
+    # on an accelerator metric the ratio floor is a hard 1.0
+    accel = json.loads(json.dumps(ANN_CPU))
+    accel["ann_ab"]["metric"] = "moco_ann_ivf_queries_per_sec"
+    accel["ann_ab"]["fused"]["qps"] = 290.0  # 0.97x composed
+    assert ledger_mod.check(ledger, _write(tmp_path / "a4.json", accel)) == 1
+
+
+SERVE_QUANT_CPU = {
+    "metric": "moco_v1_r18_cpu_smoke_imgs_per_sec",
+    "value": 10.0,
+    "serving": {
+        "metric": "moco_serve_resnet18_cpu_smoke_queries_per_sec",
+        "value": 8.0,
+        "quant": {
+            "w8": {"qps": 7.5, "cosine_vs_f32": 0.9999},
+            "w8a8": {"qps": 7.4, "cosine_vs_f32": 0.9995, "int8_kernels": False},
+        },
+    },
+}
+
+
+def test_quant_tier_gates(tmp_path):
+    ledger = str(tmp_path / "ledger.json")
+    # cosine floors held, w8a8 within the cpu ratio slack: pass
+    assert ledger_mod.check(ledger, _write(tmp_path / "q1.json", SERVE_QUANT_CPU)) == 0
+    # cosine below the 0.99 floor: fail on ANY platform
+    bad = json.loads(json.dumps(SERVE_QUANT_CPU))
+    bad["serving"]["quant"]["w8a8"]["cosine_vs_f32"] = 0.97
+    assert ledger_mod.check(ledger, _write(tmp_path / "q2.json", bad)) == 1
+    # catastrophic w8a8 slowdown: fail even with the cpu slack
+    slow = json.loads(json.dumps(SERVE_QUANT_CPU))
+    slow["serving"]["quant"]["w8a8"]["qps"] = 4.0
+    assert ledger_mod.check(ledger, _write(tmp_path / "q3.json", slow)) == 1
+    # accelerator serving: w8a8 must actually beat w8
+    accel = json.loads(json.dumps(SERVE_QUANT_CPU))
+    accel["serving"]["metric"] = "moco_serve_resnet50_queries_per_sec_per_chip"
+    accel["serving"]["quant"]["w8a8"]["qps"] = 7.0  # < w8
+    assert ledger_mod.check(ledger, _write(tmp_path / "q4.json", accel)) == 1
+    accel["serving"]["quant"]["w8a8"]["qps"] = 12.0  # beats w8
+    assert ledger_mod.check(ledger, _write(tmp_path / "q5.json", accel)) == 0

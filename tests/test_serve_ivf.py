@@ -488,3 +488,151 @@ def test_perf_ledger_gates_ann_series(tmp_path):
     # old records without an ann block still check cleanly
     write({"metric": rec["metric"], "value": 10.0})
     assert pl.check(ledger, cand) == 0
+
+
+# -- fused gather-scan tier (ISSUE 11) -----------------------------------
+
+
+@pytest.mark.parametrize("fill", [0.25, 0.6, 1.0])
+@pytest.mark.parametrize("nprobe", [4, 8])
+def test_fused_matches_composed_across_fills_and_nprobe(fill, nprobe):
+    """The fused oracle property: identical top-k ids (same candidate
+    set by construction — distinct probes, one cell per row) and
+    allclose scores vs the composed scan, across fill levels and probe
+    widths on ties-free clustered data."""
+    rows, _ = _clustered(nc=16, per=32)
+    idx = EmbeddingIndex(rows.shape[0], rows.shape[1])
+    n = int(rows.shape[0] * fill)
+    idx.snapshot(rows[:n])
+    idx.train_ivf(nlist=16, nprobe=nprobe)
+    q = _queries(rows[:n], 12)
+    sc, ic = idx.query(q, 10, mode="ivf")
+    sf, i_f = idx.query(q, 10, mode="ivf_fused")
+    np.testing.assert_array_equal(ic, i_f)
+    finite = np.isfinite(sc)
+    np.testing.assert_allclose(sf[finite], sc[finite], rtol=1e-5, atol=1e-6)
+    np.testing.assert_array_equal(np.isfinite(sf), finite)
+
+
+def test_fused_int8_matches_composed_int8():
+    rows, _ = _clustered(nc=16, per=32)
+    idx = EmbeddingIndex(rows.shape[0], rows.shape[1])
+    idx.snapshot(rows)
+    idx.train_ivf(nlist=16, nprobe=6)
+    idx.enable_int8()
+    q = _queries(rows, 10)
+    sc, ic = idx.query(q, 8, mode="ivf_i8")
+    sf, i_f = idx.query(q, 8, mode="ivf_fused_i8")
+    np.testing.assert_array_equal(ic, i_f)
+    np.testing.assert_allclose(sf, sc, rtol=1e-5, atol=1e-5)
+
+
+def test_fused_sharded_matches_single_device():
+    """Shard-width property: the fused scan over P(data)-sharded rows
+    returns exactly the single-device result (same discipline as the
+    composed-scan sharding test above)."""
+    from moco_tpu.parallel import create_mesh
+
+    rows, _ = _clustered(nc=8, per=32, dim=16)
+    q = _queries(rows, 8)
+    plain = EmbeddingIndex(rows.shape[0], 16)
+    plain.snapshot(rows)
+    plain.train_ivf(nlist=8, nprobe=4)
+    mesh = create_mesh()
+    sharded = EmbeddingIndex(rows.shape[0], 16, mesh=mesh)
+    sharded.snapshot(rows)
+    sharded.train_ivf(nlist=8, nprobe=4)
+    s1, i1 = plain.query(q, 5, mode="ivf_fused")
+    s2, i2 = sharded.query(q, 5, mode="ivf_fused")
+    np.testing.assert_array_equal(i1, i2)
+    np.testing.assert_allclose(s1, s2, rtol=1e-5, atol=1e-6)
+    # and the fused result equals the composed result on the mesh too
+    s3, i3 = sharded.query(q, 5, mode="ivf")
+    np.testing.assert_array_equal(i2, i3)
+
+
+def test_fused_pallas_interpret_matches_composed(monkeypatch):
+    """The Pallas cell-DMA lowering (scalar-prefetched cell tiles from
+    the cell-major row copy) in interpret mode returns the composed
+    scan's exact ids — the equivalence CI can check without a chip."""
+    monkeypatch.setenv("MOCO_IVF_PALLAS", "interpret")
+    rows, _ = _clustered(nc=8, per=32, dim=16)
+    q = _queries(rows, 6)
+    idx = EmbeddingIndex(rows.shape[0], 16)
+    assert idx._fused_pallas and idx._fused_interpret
+    idx.snapshot(rows)
+    idx.train_ivf(nlist=8, nprobe=4)
+    sc, ic = idx.query(q, 5, mode="ivf")
+    sf, i_f = idx.query(q, 5, mode="ivf_fused")
+    np.testing.assert_array_equal(ic, i_f)
+    np.testing.assert_allclose(sf, sc, rtol=1e-5, atol=1e-6)
+
+
+def test_fused_follows_fifo_ingest():
+    """Incremental maintenance parity: after FIFO writes re-home cells,
+    the fused scan still mirrors the composed scan (the cell-major
+    Pallas copy is also invalidated — covered via the dirty flag)."""
+    rows, _ = _clustered(nc=8, per=16, dim=8)
+    idx = EmbeddingIndex(rows.shape[0], 8)
+    idx.snapshot(rows)
+    idx.train_ivf(nlist=8, nprobe=4)
+    fresh = _queries(rows, 16, seed=9, noise=0.3)
+    idx.add(fresh)
+    q = _queries(rows, 8, seed=10)
+    sc, ic = idx.query(q, 5, mode="ivf")
+    sf, i_f = idx.query(q, 5, mode="ivf_fused")
+    np.testing.assert_array_equal(ic, i_f)
+    np.testing.assert_allclose(sf, sc, rtol=1e-5, atol=1e-6)
+
+
+def test_frozen_rejects_unprepared_fused_modes():
+    rows, _ = _clustered(nc=4, per=16, dim=8)
+    idx = EmbeddingIndex(rows.shape[0], 8)
+    idx.snapshot(rows)
+    idx.train_ivf(nlist=4, nprobe=2)
+    idx.enable_int8()
+    idx.prepare([4], k=3, modes=("ivf_fused",))
+    idx.freeze()
+    q = _queries(rows, 4)
+    idx.query(q, 3, mode="ivf_fused")  # prepared: fine
+    assert idx.recompiles_after_warmup == 0
+    with pytest.raises(IndexRecompileError):
+        idx.query(q[:2], 3, mode="ivf_fused")  # unprepared m
+    with pytest.raises(IndexRecompileError):
+        idx.query(q, 3, mode="ivf_fused_i8")  # unprepared quantized twin
+
+
+def test_ivf_stats_occupancy_gauge():
+    rows, _ = _clustered(nc=8, per=16, dim=8)
+    idx = EmbeddingIndex(rows.shape[0], 8)
+    idx.snapshot(rows)
+    stats = idx.train_ivf(nlist=8, nprobe=4)
+    assert 0.0 < stats["occupancy"] <= 1.0
+    assert stats["occupancy"] == pytest.approx(
+        stats["cell_count_mean"] / stats["cell_cap"]
+    )
+
+
+def test_batcher_mode_counts_surface():
+    """serve/mode_<tier> counts: explicit riders under their tier,
+    default-mode riders under "default"."""
+    from moco_tpu.serve.batcher import ContinuousBatcher
+
+    def run_batch(images, want_neighbors, modes=()):
+        return {"embedding": np.zeros((images.shape[0], 4), np.float32)}, [
+            (images.shape[0], images.shape[0])
+        ]
+
+    b = ContinuousBatcher(run_batch, max_batch=8, slo_ms=50.0)
+    try:
+        imgs = np.zeros((1, 4, 4, 3), np.uint8)
+        futs = [b.submit(imgs, want_neighbors=True, mode="ivf_fused")
+                for _ in range(3)]
+        futs += [b.submit(imgs, want_neighbors=True) for _ in range(2)]
+        for f in futs:
+            f.result(timeout=10.0)
+    finally:
+        b.close()
+    payload = b.metrics.payload()
+    assert payload["serve/mode_ivf_fused"] == 3
+    assert payload["serve/mode_default"] == 2

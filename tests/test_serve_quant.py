@@ -1,0 +1,279 @@
+"""Activation-quantized int8 serving (ISSUE 11): calibration observer
+determinism + artifact roundtrip, the w8a8 engine's embedding-cosine
+floor per bucket, true-int8-vs-emulation equivalence, frozen-recompile
+discipline on the quantized bucket keys, the extended donation audit,
+and the quant/ivf gauges on the serving surface."""
+
+import json
+import os
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from moco_tpu.serve import quant
+from moco_tpu.serve.engine import (
+    EngineRecompileError,
+    InferenceEngine,
+    quantize_params_int8,
+)
+
+IMG = 32  # test_serve.py's lesson: XLA:CPU's 16px conv path is ~10x slower
+
+
+@pytest.fixture(scope="module")
+def toy_encoder():
+    from moco_tpu.core import build_encoder
+    from moco_tpu.utils.config import MocoConfig
+
+    cfg = MocoConfig(
+        arch="resnet18", dim=16, mlp=True, cifar_stem=True,
+        shuffle="none", compute_dtype="float32",
+    )
+    enc = build_encoder(cfg)
+    v = enc.init(jax.random.PRNGKey(0), jnp.zeros((1, IMG, IMG, 3)), train=False)
+    return enc, v["params"], v.get("batch_stats", {})
+
+
+@pytest.fixture(scope="module")
+def calib_sample():
+    return np.random.default_rng(7).integers(0, 255, (16, IMG, IMG, 3), np.uint8)
+
+
+@pytest.fixture(scope="module")
+def toy_calibration(toy_encoder, calib_sample):
+    enc, params, stats = toy_encoder
+    return quant.calibrate_encoder(enc, params, stats, calib_sample, IMG)
+
+
+@pytest.fixture(scope="module")
+def engines(toy_encoder, toy_calibration):
+    """(f32, w8a8) engine pair on shared buckets — AOT compiles are the
+    slow part, so every embedding test shares this pair."""
+    enc, params, stats = toy_encoder
+    f32 = InferenceEngine(enc, params, stats, image_size=IMG, buckets=(1, 4, 8))
+    w8a8 = InferenceEngine(
+        enc, params, stats, image_size=IMG, buckets=(1, 4, 8),
+        engine_quant="w8a8", calibration=toy_calibration,
+    )
+    w8a8.warmup()
+    return f32, w8a8
+
+
+# -- calibration ----------------------------------------------------------
+
+
+def test_calibration_deterministic_and_covering(toy_encoder, calib_sample, toy_calibration):
+    """Same sample → bitwise-identical ranges (eager f32 forward, no
+    PRNG), covering every layer quantize_params_int8 will quantize."""
+    enc, params, stats = toy_encoder
+    again = quant.calibrate_encoder(enc, params, stats, calib_sample, IMG)
+    assert again == toy_calibration  # floats bitwise-equal, keys sorted
+    covered = set(toy_calibration["amax"])
+    assert quant.quantized_layer_paths(params) <= covered
+    assert toy_calibration["num_layers"] == len(covered)
+    assert all(v >= 0.0 for v in toy_calibration["amax"].values())
+
+
+def test_calibration_artifact_roundtrip(tmp_path, toy_calibration):
+    """save → load is the identity (json floats via repr), whether
+    addressed as a file or as the checkpoint directory."""
+    path = quant.save_calibration(str(tmp_path), toy_calibration)
+    assert os.path.basename(path) == quant.CALIBRATION_FILENAME
+    assert quant.load_calibration(path) == toy_calibration
+    assert quant.load_calibration(str(tmp_path)) == toy_calibration
+    with open(path) as f:
+        raw = json.load(f)
+    assert raw["version"] == quant.CALIBRATION_VERSION
+
+
+def test_calibration_validation_rejects_mismatch(toy_encoder, toy_calibration):
+    _, params, _ = toy_encoder
+    with pytest.raises(ValueError, match="image_size"):
+        quant.validate_calibration(toy_calibration, params, IMG * 2)
+    clipped = dict(toy_calibration)
+    clipped["amax"] = dict(list(toy_calibration["amax"].items())[:3])
+    with pytest.raises(ValueError, match="uncovered"):
+        quant.validate_calibration(clipped, params, IMG)
+
+
+def test_w8a8_requires_calibration(toy_encoder):
+    enc, params, stats = toy_encoder
+    with pytest.raises(ValueError, match="calib"):
+        InferenceEngine(
+            enc, params, stats, image_size=IMG, buckets=(1,), engine_quant="w8a8"
+        )
+    with pytest.raises(ValueError, match="engine_quant"):
+        InferenceEngine(
+            enc, params, stats, image_size=IMG, buckets=(1,), engine_quant="int4"
+        )
+
+
+# -- embedding quality ----------------------------------------------------
+
+
+def _mean_cos(a, b):
+    a = np.asarray(a, np.float64)
+    b = np.asarray(b, np.float64)
+    return float(np.mean(np.sum(a * b, axis=-1)))  # rows L2-normalized
+
+
+def test_w8a8_cosine_floor_per_bucket(engines):
+    """The acceptance floor, per bucket: every padded-bucket executable
+    of the quantized engine embeds within cosine 0.99 of f32."""
+    f32, w8a8 = engines
+    rng = np.random.default_rng(0)
+    for n in (1, 4, 8):
+        imgs = rng.integers(0, 255, (n, IMG, IMG, 3), np.uint8)
+        ef, _ = f32.embed(imgs)
+        eq, _ = w8a8.embed(imgs)
+        assert _mean_cos(ef, eq) >= 0.99, f"bucket {n}"
+        np.testing.assert_allclose(np.linalg.norm(eq, axis=1), 1.0, rtol=1e-5)
+
+
+def test_w8a8_actually_quantizes(engines):
+    """The quantized tier must not silently serve f32: its embeddings
+    differ from the f32 engine's (activation rounding is real), while
+    the back-compat gauges report the tier."""
+    f32, w8a8 = engines
+    imgs = np.random.default_rng(1).integers(0, 255, (4, IMG, IMG, 3), np.uint8)
+    ef, _ = f32.embed(imgs)
+    eq, _ = w8a8.embed(imgs)
+    assert np.abs(ef - eq).max() > 0  # not bit-identical: a8 is live
+    assert w8a8.quant == "w8a8" and w8a8.int8 and w8a8.calibration is not None
+    assert f32.quant == "off" and not f32.int8
+
+
+def test_int8_true_kernels_match_emulation(toy_encoder, toy_calibration):
+    """`int8_compute=True` (the tpu/gpu path, runnable on CPU through
+    XLA's generic int8 lowering) and the CPU scaled-integer emulation
+    are the SAME arithmetic: int8×int8 products summed exactly. One
+    small bucket keeps the generic int8 conv affordable."""
+    enc, params, stats = toy_encoder
+    imgs = np.random.default_rng(2).integers(0, 255, (1, IMG, IMG, 3), np.uint8)
+    outs = {}
+    for flag in (False, True):
+        e = InferenceEngine(
+            enc, params, stats, image_size=IMG, buckets=(1,),
+            engine_quant="w8a8", calibration=toy_calibration,
+            int8_compute=flag,
+        )
+        outs[flag], _ = e.embed(imgs)
+    np.testing.assert_array_equal(outs[False], outs[True])
+
+
+def test_quantized_apply_micro_module():
+    """quant.py is module-generic: a micro conv+dense net quantizes
+    through the same observer/apply pair, and the w8a8 output tracks
+    the f32 output within the per-tensor quantization error budget."""
+
+    class Micro(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = nn.Conv(8, (3, 3), padding="SAME")(x)
+            x = nn.relu(x)
+            x = x.reshape((x.shape[0], -1))
+            return nn.Dense(4)(x)
+
+    m = Micro()
+    x = jnp.asarray(np.random.default_rng(3).normal(size=(2, 8, 8, 3)), jnp.float32)
+    v = m.init(jax.random.PRNGKey(0), x)
+    ref = m.apply(v, x)
+    obs = quant.ActivationObserver()
+    with obs.intercept():
+        m.apply(v, x)
+    assert len(obs.amax) == 2
+    qp, qs = quantize_params_int8(v["params"])
+    scales = {p: jnp.float32(s) for p, s in quant.fit_scales(obs.amax).items()}
+    out = quant.quantized_apply(m, qp, qs, {}, scales, x, int8_compute=False)
+    assert np.abs(np.asarray(out) - np.asarray(ref)).max() < 0.15
+    assert np.abs(np.asarray(out) - np.asarray(ref)).max() > 0  # quantized
+
+
+# -- freeze + donation discipline -----------------------------------------
+
+
+def test_quant_engine_frozen_recompile_raises(engines):
+    """The (mode, quant) bucket keys obey the same freeze contract as
+    the f32 engine: a warm quantized engine refuses new buckets."""
+    _, w8a8 = engines
+    assert w8a8.recompiles_after_warmup == 0
+    with pytest.raises(EngineRecompileError):
+        w8a8._compile(64)
+
+
+def test_quant_donation_audit_extends_to_qtrees(engines):
+    """On CPU: input donation gated off (None), quantized trees audited
+    alive (True) — never False, which serve_smoke fails loudly on."""
+    _, w8a8 = engines
+    w8a8.embed(np.zeros((2, IMG, IMG, 3), np.uint8))
+    audit = w8a8.donation_audit()
+    qtree_keys = [k for k in audit if isinstance(k, str) and k.startswith("qtree:")]
+    assert qtree_keys, audit
+    assert all(audit[k] is True for k in qtree_keys), audit
+    assert not any(v is False for v in audit.values()), audit
+
+
+def test_w8_backcompat_spelling(toy_encoder):
+    """int8=True still means weight-only PTQ (the PR-9 contract)."""
+    enc, params, stats = toy_encoder
+    e = InferenceEngine(
+        enc, params, stats, image_size=IMG, buckets=(1,), int8=True
+    )
+    assert e.quant == "w8" and e.int8
+    out, _ = e.embed(np.zeros((1, IMG, IMG, 3), np.uint8))
+    assert out.shape[0] == 1
+
+
+# -- serving surface ------------------------------------------------------
+
+
+def test_server_quant_and_ivf_gauges(engines):
+    """GET /stats carries serve/quant_tier and the ivf_stats() gauges
+    (serve/ivf_spill, serve/ivf_occupancy) the ROADMAP names as the
+    re-fit trigger; schema validates the flushed line."""
+    from moco_tpu.obs import schema
+    from moco_tpu.serve.index import EmbeddingIndex
+    from moco_tpu.serve.server import ServeServer
+
+    _, w8a8 = engines
+    rng = np.random.default_rng(5)
+    dim = w8a8.num_features or 16
+    rows = rng.normal(size=(64, dim)).astype(np.float32)
+    rows /= np.linalg.norm(rows, axis=1, keepdims=True)
+    index = EmbeddingIndex(64, dim)
+    index.snapshot(rows)
+    index.train_ivf(nlist=4, nprobe=4)
+    server = ServeServer(
+        w8a8, index=index, port=0, slo_ms=1000.0,
+        neighbors_k=3, neighbors_mode="ivf_fused", nprobe=4,
+    )
+    try:
+        stats = server.stats()
+    finally:
+        server.close()
+    assert stats["serve/quant_tier"] == 2
+    assert stats["serve/int8"] == 1
+    assert stats["serve/ivf_spill"] == index.ivf_stats()["spilled"]
+    assert stats["serve/ivf_occupancy"] == pytest.approx(
+        index.ivf_stats()["occupancy"]
+    )
+    line = {k: v for k, v in stats.items() if k.startswith("serve/")}
+    errors = schema.validate_line(dict(line, step=0, time=0.0))
+    assert not errors, errors
+
+
+def test_schema_quant_validators():
+    from moco_tpu.obs import schema
+
+    ok = {"step": 0, "time": 0.0, "serve/quant_tier": 2,
+          "serve/ivf_spill": 0, "serve/ivf_occupancy": 0.5}
+    assert not schema.validate_line(ok)
+    for bad in (
+        {"serve/quant_tier": 3},
+        {"serve/ivf_spill": -1},
+        {"serve/ivf_occupancy": 1.5},
+    ):
+        assert schema.validate_line(dict(bad, step=0, time=0.0)), bad
