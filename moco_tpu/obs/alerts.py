@@ -60,16 +60,27 @@ _INT_KEYS = ("window", "warmup", "consecutive", "cooldown")
 _FLOAT_KEYS = ("value", "factor", "timeout")
 _STR_KEYS = ("name", "field", "num", "den", "event", "op", "severity")
 
-DEFAULT_SPEC = (
-    "spike@name=step_time_spike:field=t_step:factor=3:window=32:warmup=8,"
-    "ratio@name=data_starvation:num=t_data:den=t_step:value=0.6:consecutive=3,"
-    "threshold@name=straggler_skew_high:field=straggler_skew:value=0.5,"
-    "threshold@name=ema_drift_runaway:field=ema_drift:value=0.5,"
-    "threshold@name=queue_stale:field=queue_stale_seconds:value=600,"
-    "event@name=nonfinite_loss:event=nonfinite_loss,"
-    "event@name=stall:event=stall,"
-    "heartbeat@name=heartbeat_loss:timeout=120:severity=fatal"
-)
+DEFAULT_HEARTBEAT_TIMEOUT = 120.0
+
+
+def default_spec(heartbeat_timeout: float = DEFAULT_HEARTBEAT_TIMEOUT) -> str:
+    """The built-in rule set, with the heartbeat-staleness threshold
+    parameterized (config.heartbeat_timeout / --heartbeat-timeout): the
+    same threshold the elastic rescale trigger uses, so the alert and
+    the rescale agree on what "lost" means."""
+    return (
+        "spike@name=step_time_spike:field=t_step:factor=3:window=32:warmup=8,"
+        "ratio@name=data_starvation:num=t_data:den=t_step:value=0.6:consecutive=3,"
+        "threshold@name=straggler_skew_high:field=straggler_skew:value=0.5,"
+        "threshold@name=ema_drift_runaway:field=ema_drift:value=0.5,"
+        "threshold@name=queue_stale:field=queue_stale_seconds:value=600,"
+        "event@name=nonfinite_loss:event=nonfinite_loss,"
+        "event@name=stall:event=stall,"
+        f"heartbeat@name=heartbeat_loss:timeout={heartbeat_timeout:g}:severity=fatal"
+    )
+
+
+DEFAULT_SPEC = default_spec()
 
 
 class FatalAlertError(RuntimeError):
@@ -96,10 +107,14 @@ class AlertRule:
     severity: str = "warn"
 
 
-def parse_rules(spec: Optional[str]) -> list[AlertRule]:
+def parse_rules(
+    spec: Optional[str], heartbeat_timeout: Optional[float] = None
+) -> list[AlertRule]:
     """Rules from a spec string; '' / 'none' -> no rules; the entry
     'default' expands in place, so 'default,threshold@name=...' extends
-    the built-ins."""
+    the built-ins. `heartbeat_timeout` parameterizes the default set's
+    heartbeat_loss threshold (explicit heartbeat@ rules keep their own
+    timeout=)."""
     if not spec or spec.strip().lower() == "none":
         return []
     rules: list[AlertRule] = []
@@ -109,7 +124,12 @@ def parse_rules(spec: Optional[str]) -> list[AlertRule]:
         if not part:
             continue
         if part.lower() == "default":
-            for r in parse_rules(DEFAULT_SPEC):
+            expanded = default_spec(
+                heartbeat_timeout
+                if heartbeat_timeout is not None
+                else DEFAULT_HEARTBEAT_TIMEOUT
+            )
+            for r in parse_rules(expanded):
                 if r.name not in seen:
                     seen.add(r.name)
                     rules.append(r)
@@ -346,7 +366,9 @@ def read_alerts(path: str) -> list[dict]:
 
 
 __all__ = [
+    "DEFAULT_HEARTBEAT_TIMEOUT",
     "DEFAULT_SPEC",
+    "default_spec",
     "AlertEngine",
     "AlertRule",
     "FatalAlertError",

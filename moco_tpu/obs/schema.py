@@ -46,7 +46,12 @@ import json
 from typing import Any, Iterable
 
 EVENT_KINDS = frozenset(
-    {"nonfinite_loss", "stall", "recompile_after_warmup", "alert"}
+    {"nonfinite_loss", "stall", "recompile_after_warmup", "alert",
+     # graceful-preemption exit (SIGTERM -> emergency checkpoint) and
+     # the elastic checkpoint-and-rescale (parallel/elastic.py): the
+     # rescale line carries the rescale/* family below — old/new mesh
+     # shape, old/new global batch, and the re-derived hyperparameters
+     "preempt", "rescale"}
 )
 
 TRAIN_REQUIRED = ("epoch", "lr", "loss", "acc1", "acc5")
@@ -198,6 +203,13 @@ FIELD_VALIDATORS = {
     "serve/p99_exemplar_ms": _nonneg_or_null,
     "serve/slo_objective": lambda v: _num(v) and 0.0 < v < 1.0,
     "serve/trace_overhead_pct": _num_or_null,
+    # elastic rescale event lines (parallel/elastic.py): the lost host
+    # indices (list of ints) ride the otherwise-numeric rescale/ family
+    "rescale/dead_hosts": _num_list,
+    "rescale/old_num_data": _int_like,
+    "rescale/new_num_data": _int_like,
+    "rescale/old_global_batch": _int_like,
+    "rescale/new_global_batch": _int_like,
     # fleet observability (obs/fleet.py; process-0 lines only)
     "fleet_hosts": _int_like,
     "straggler_skew": _num_or_null,
@@ -216,6 +228,9 @@ FIELD_VALIDATORS = {
 # slo_violations, slo_ms, bucket_<b> histogram counts)
 PREFIX_VALIDATORS = {
     "ema_drift/": _num_or_null,
+    # elastic rescale event fields (kappa, derived lr/momentum, ...);
+    # the explicit entries above (dead_hosts list, int mesh shapes) win
+    "rescale/": _num_or_null,
     "fleet/": _num_or_null,
     "comms/": _num,
     "alert/": _num,

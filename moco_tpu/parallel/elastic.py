@@ -1,0 +1,282 @@
+"""Elastic training: heartbeat-triggered checkpoint-and-rescale.
+
+PR 1 can only restart a fixed-shape job from its last checkpoint; PR 4's
+heartbeats can only *name* a dead host. This module closes the loop the
+ROADMAP's "elastic fleet" item describes: when a host stops beating
+(crash, preemption, the `kill@host=i` chaos fault), the survivors
+
+1. **detect** the loss out-of-band — `ElasticCoordinator.stale_hosts()`
+   reads the per-process `heartbeat.p<i>.json` files (obs/fleet.py) and
+   flags any whose age exceeds the configurable `--heartbeat-timeout`;
+2. **agree** on the event — `agree()` is a rescale-consensus barrier in
+   the style of the collective-schedule sanitizer's out-of-band exchange
+   (analysis/sanitizer.py): each survivor atomically publishes its plan
+   to `rescale.p<i>.json` and polls until every surviving peer published
+   a matching one, so no process reshapes alone while another is still
+   dispatching collectives on the old mesh;
+3. **checkpoint** — the driver takes an emergency save of the last
+   known-finite state (the fault-tolerance layer's save-first path);
+4. **reshard + rescale** — `plan_rescale()` picks the widest surviving
+   mesh that preserves the queue/batch divisibility invariants
+   (`K % global_batch == 0`, per-device batch held constant) and
+   re-derives the momentum/LR hyperparameters through the `--auto-scale`
+   rule (utils/config.py `apply_auto_scale`): with κ = new_batch /
+   ref_batch, the EMA momentum scales as m^κ ("How to Scale Your EMA",
+   arXiv:2307.13813; Momentum² Teacher, arXiv:2101.07525) and the LR
+   linearly — a principled rescale, not silent hyperparameter drift;
+5. **resume in-process** — the driver re-enters its setup with the
+   shrunk config; the existing layout-aware resume restores the
+   emergency checkpoint into ITS OWN layout and converts host-side
+   through `reshard_state` (core/moco.py) / the ZeRO flat-shard
+   converters (parallel/zero.py), so params, optimizer shards, and the
+   queue land on the surviving mesh without a from-scratch restart.
+
+Single-process fake-fleet simulation (CI, `scripts/elastic_smoke.py`):
+each virtual device doubles as a "host"; the kill fault stamps a stale
+heartbeat and the whole loop — detection, consensus, checkpoint,
+reshard, rescale, resume — runs for real in one process. On a real
+multi-process fleet the same detection + consensus + checkpoint path
+runs, but the JAX distributed runtime cannot shrink in-process: every
+survivor exits with `RESCALE_EXIT_CODE` after the durable save, and the
+launcher restarts the surviving hosts with the derived config (the
+resume then reshards exactly as in the simulated path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Optional, Sequence
+
+from moco_tpu.utils.config import TrainConfig, apply_auto_scale
+
+# Exit code a multi-process survivor leaves with after the consensus +
+# emergency checkpoint (the launcher's signal to relaunch the surviving
+# hosts with the derived config). Distinct from the watchdog's 42 and
+# the kill fault's KILL_EXIT_CODE.
+RESCALE_EXIT_CODE = 75
+
+
+@dataclasses.dataclass(frozen=True)
+class RescalePlan:
+    """The agreed rescale: which hosts died, at which step, and the
+    derived mesh/batch shape every survivor must adopt."""
+
+    step: int
+    dead_hosts: tuple  # ALL dead host indices (cumulative across rescales)
+    old_num_data: int
+    new_num_data: int
+    old_global_batch: int
+    new_global_batch: int
+
+    def consensus_key(self) -> dict:
+        """The fields survivors must agree on byte-for-byte (step is
+        excluded: wall-clock staleness may be observed one log step
+        apart across hosts; the plan they derive from it may not
+        differ)."""
+        return {
+            "dead_hosts": sorted(int(h) for h in self.dead_hosts),
+            "new_num_data": int(self.new_num_data),
+            "new_global_batch": int(self.new_global_batch),
+        }
+
+
+class ElasticRescale(RuntimeError):
+    """Raised by the driver's log-step elastic check after the
+    emergency checkpoint is durable; `train()` catches it, adopts
+    `new_config`, and re-enters the setup on the surviving mesh."""
+
+    def __init__(self, plan: RescalePlan, new_config: TrainConfig, info: dict):
+        super().__init__(
+            f"elastic rescale at step {plan.step}: hosts {list(plan.dead_hosts)} "
+            f"lost, mesh {plan.old_num_data} -> {plan.new_num_data}, global "
+            f"batch {plan.old_global_batch} -> {plan.new_global_batch}"
+        )
+        self.plan = plan
+        self.new_config = new_config
+        self.info = info
+
+
+def feasible_width(
+    survivors: int, per_device_batch: int, num_negatives: int
+) -> int:
+    """The widest data-axis width ≤ `survivors` that keeps the training
+    invariants intact at a constant per-device batch: the queue's
+    `K % global_batch == 0` FIFO invariant (core/queue.py) must hold for
+    the shrunk global batch. Raises when no width survives (the fleet
+    is below the minimum viable mesh)."""
+    if survivors < 1:
+        raise ValueError("no surviving hosts — nothing to rescale onto")
+    for n in range(survivors, 0, -1):
+        if num_negatives > 0 and num_negatives % (per_device_batch * n):
+            continue
+        return n
+    raise ValueError(
+        f"no mesh width <= {survivors} keeps K={num_negatives} divisible by "
+        f"the global batch (per-device batch {per_device_batch})"
+    )
+
+
+def surviving_devices(dead_hosts: Sequence[int]):
+    """The device list a post-rescale mesh builds over. Multi-process:
+    a dead host's devices are the dead process's. Single process
+    (fake-fleet simulation): device index i IS host i — the same
+    one-device-per-host convention the FleetAggregator uses."""
+    import jax
+
+    dead = set(int(h) for h in dead_hosts)
+    if jax.process_count() > 1:
+        return [d for d in jax.devices() if d.process_index not in dead]
+    return [d for i, d in enumerate(jax.devices()) if i not in dead]
+
+
+def plan_rescale(
+    ref_config: TrainConfig,
+    num_data: int,
+    num_model: int,
+    dead_hosts: Sequence[int],
+    step: int,
+) -> tuple[RescalePlan, TrainConfig, dict]:
+    """Derive the post-loss world from the reference config: surviving
+    devices → feasible mesh width (per-device batch constant) → new
+    global batch → re-derived momentum/LR via the auto-scale rule.
+
+    `ref_config` carries the REFERENCE hyperparameters (lr/momentum at
+    `auto_scale` ref_batch), so repeated rescales always derive from the
+    same anchor rather than compounding already-scaled values. Returns
+    (plan, new reference config, derived-hyperparameter info)."""
+    if num_model != 1:
+        raise ValueError("elastic rescale supports num_model=1 meshes only")
+    per_dev = ref_config.data.global_batch // num_data
+    if per_dev * num_data != ref_config.data.global_batch:
+        raise ValueError(
+            f"global batch {ref_config.data.global_batch} not divisible by "
+            f"the data axis {num_data}"
+        )
+    survivors = len(surviving_devices(dead_hosts)) // num_model
+    new_n = feasible_width(survivors, per_dev, ref_config.moco.num_negatives)
+    new_batch = per_dev * new_n
+    plan = RescalePlan(
+        step=int(step),
+        dead_hosts=tuple(sorted(int(h) for h in dead_hosts)),
+        old_num_data=int(num_data),
+        new_num_data=int(new_n),
+        old_global_batch=int(ref_config.data.global_batch),
+        new_global_batch=int(new_batch),
+    )
+    new_ref = dataclasses.replace(
+        ref_config,
+        data=dataclasses.replace(ref_config.data, global_batch=new_batch),
+        parallel=dataclasses.replace(ref_config.parallel, num_data=new_n),
+    )
+    _, info = apply_auto_scale(new_ref)
+    return plan, new_ref, dict(info or {})
+
+
+def rescale_path(workdir: str, process_index: int) -> str:
+    return os.path.join(workdir, f"rescale.p{process_index}.json")
+
+
+class ElasticCoordinator:
+    """Per-process detection + consensus for the elastic loop.
+
+    `stale_hosts()` is the heartbeat-staleness detector (called by the
+    driver on log steps, off the hot path); `agree()` is the
+    rescale-consensus barrier over atomic `rescale.p<i>.json` files —
+    the same out-of-band publish/poll pattern the collective-schedule
+    sanitizer uses, so it needs no working collective (the dead host may
+    be wedged inside one)."""
+
+    def __init__(
+        self,
+        workdir: str,
+        process_index: int = 0,
+        num_processes: int = 1,
+        timeout: float = 120.0,
+        known_dead: Sequence[int] = (),
+        barrier_timeout: float = 60.0,
+        poll_interval: float = 0.05,
+    ):
+        self.workdir = workdir
+        self.process_index = int(process_index)
+        self.num_processes = int(num_processes)
+        self.timeout = float(timeout)
+        self.known_dead = set(int(h) for h in known_dead)
+        self.barrier_timeout = float(barrier_timeout)
+        self.poll_interval = float(poll_interval)
+
+    def stale_hosts(self, now: Optional[float] = None) -> list[int]:
+        """Host indices whose heartbeat file is older than the timeout —
+        newly dead only (self and already-rescaled-away hosts are
+        excluded). A host with NO heartbeat file is not reported: it
+        never joined this run's fleet (simulated hosts appear only once
+        the chaos harness stamps them)."""
+        from moco_tpu.obs.fleet import read_heartbeats
+
+        now = time.time() if now is None else now
+        stale = []
+        for p, rec in read_heartbeats(self.workdir).items():
+            if p == self.process_index or p in self.known_dead:
+                continue
+            if now - float(rec.get("time", 0.0)) > self.timeout:
+                stale.append(p)
+        return sorted(stale)
+
+    def agree(self, plan: RescalePlan) -> RescalePlan:
+        """Publish this process's plan and block until every surviving
+        peer published a MATCHING one (consensus_key equality). Returns
+        the agreed plan; raises RuntimeError on barrier timeout or a
+        conflicting peer plan — both mean the fleet does not share one
+        view of who died, and proceeding would re-shard into a split
+        brain."""
+        key = plan.consensus_key()
+        path = rescale_path(self.workdir, self.process_index)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"process": self.process_index, "time": time.time(), **key}, f)
+        os.replace(tmp, path)
+        survivors = [
+            p
+            for p in range(self.num_processes)
+            if p != self.process_index and p not in set(plan.dead_hosts)
+        ]
+        deadline = time.time() + self.barrier_timeout
+        pending = set(survivors)
+        while pending:
+            for p in sorted(pending):
+                try:
+                    with open(rescale_path(self.workdir, p)) as f:
+                        peer = json.load(f)
+                except (OSError, ValueError):
+                    continue
+                peer_key = {k: peer.get(k) for k in key}
+                if peer_key == key:
+                    pending.discard(p)
+                elif peer.get("time", 0.0) >= time.time() - self.barrier_timeout:
+                    raise RuntimeError(
+                        f"rescale consensus conflict: process {p} proposes "
+                        f"{peer_key}, this process {key}"
+                    )
+            if pending and time.time() > deadline:
+                raise RuntimeError(
+                    f"rescale consensus barrier timed out after "
+                    f"{self.barrier_timeout:g}s waiting for processes "
+                    f"{sorted(pending)}"
+                )
+            if pending:
+                time.sleep(self.poll_interval)
+        return plan
+
+
+__all__ = [
+    "RESCALE_EXIT_CODE",
+    "ElasticCoordinator",
+    "ElasticRescale",
+    "RescalePlan",
+    "feasible_width",
+    "plan_rescale",
+    "rescale_path",
+    "surviving_devices",
+]

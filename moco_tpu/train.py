@@ -44,6 +44,13 @@ from moco_tpu.obs.alerts import AlertEngine, FatalAlertError, parse_rules
 from moco_tpu.obs.fleet import FleetAggregator, Heartbeat
 from moco_tpu.obs.sinks import build_sinks, per_process_filename
 from moco_tpu.obs.stepstats import StepTimeProbe, memory_payload, tree_shard_bytes
+from moco_tpu.parallel.elastic import (
+    RESCALE_EXIT_CODE,
+    ElasticCoordinator,
+    ElasticRescale,
+    plan_rescale,
+    surviving_devices,
+)
 from moco_tpu.parallel.zero import AsyncParamGather, unshard_tree_host
 from moco_tpu.parallel import create_mesh, create_multislice_mesh, maybe_initialize_multihost
 from moco_tpu.utils import faults, retry
@@ -51,6 +58,7 @@ from moco_tpu.utils.checkpoint import CheckpointManager
 from moco_tpu.utils.config import (
     ResumeCompatError,
     TrainConfig,
+    apply_auto_scale,
     config_to_dict,
     resume_compat_diff,
 )
@@ -120,7 +128,46 @@ def train(
     )
     prev_tracer = obs.set_tracer(tracer)
     try:
-        return _train_impl(config, dataset, profile_dir, knn_datasets, profile_steps)
+        # Elastic outer loop (parallel/elastic.py): each _train_impl
+        # attempt runs on one mesh shape; an ElasticRescale (heartbeat
+        # loss -> consensus -> emergency checkpoint, raised from the
+        # log-step elastic check) shrinks the world and re-enters the
+        # setup IN-PROCESS — the resume machinery restores the emergency
+        # checkpoint and reshards it onto the surviving mesh
+        # (reshard_state), so nothing restarts from scratch.
+        ref_config = config
+        if ref_config.elastic and not ref_config.auto_scale:
+            # anchor the scaling rules at the pre-loss batch, so a
+            # rescale derives kappa against the original recipe rather
+            # than drifting hyperparameters silently
+            ref_config = dataclasses.replace(
+                ref_config, auto_scale=f"ref_batch={ref_config.data.global_batch}"
+            )
+        dead_hosts: set = set()
+        while True:
+            try:
+                return _train_impl(
+                    ref_config, dataset, profile_dir, knn_datasets, profile_steps,
+                    dead_hosts=frozenset(dead_hosts),
+                )
+            except ElasticRescale as r:
+                if jax.process_count() > 1:
+                    # a real multi-process fleet cannot shrink the JAX
+                    # distributed runtime in-process: the emergency
+                    # checkpoint is durable and the plan is agreed —
+                    # exit with the rescale code so the launcher
+                    # relaunches the survivors with the derived shape
+                    # (the resume then reshards onto it).
+                    print0(
+                        f"elastic rescale (multi-process): {r}; exiting "
+                        f"{RESCALE_EXIT_CODE} for the launcher to relaunch "
+                        f"with --num-data {r.plan.new_num_data} "
+                        f"--batch-size {r.plan.new_global_batch}"
+                    )
+                    raise SystemExit(RESCALE_EXIT_CODE) from r
+                dead_hosts |= set(r.plan.dead_hosts)
+                ref_config = r.new_config
+                print0(f"{r} — resuming in-process on the surviving mesh")
     finally:
         try:
             tracer.export_chrome(
@@ -138,12 +185,36 @@ def _train_impl(
     profile_dir: Optional[str],
     knn_datasets,
     profile_steps: Optional[tuple],
+    dead_hosts: frozenset = frozenset(),
 ) -> dict:
     # (the multi-host rendezvous already ran in train(), before the
     # tracer needed the process index; this is a no-op then, and keeps
     # direct _train_impl callers working)
     maybe_initialize_multihost()
-    if config.parallel.num_data is None:
+    # Auto-scale (utils/config.py): `config` arrives carrying REFERENCE
+    # hyperparameters; the live lr / EMA momentum are derived here from
+    # the actual global batch (kappa = batch/ref_batch: lr linear,
+    # momentum m^kappa). The reference config is kept for the elastic
+    # rescale, which must re-derive against the same anchor.
+    ref_config = config
+    config, auto_info = apply_auto_scale(config)
+    if auto_info is not None:
+        print0(
+            f"auto-scale: global batch {config.data.global_batch} vs ref "
+            f"{auto_info['ref_batch']} (kappa={auto_info['kappa']:g}) -> "
+            f"lr {auto_info['lr']:g}, EMA momentum {auto_info['momentum']:g}"
+        )
+    if config.elastic and config.parallel.num_model > 1:
+        raise ValueError("elastic=True supports num_model=1 meshes only")
+    if dead_hosts:
+        # post-rescale attempt: the mesh covers the SURVIVING devices
+        # only (the agreed width; feasibility was decided by the plan)
+        mesh = create_mesh(
+            num_data=config.parallel.num_data,
+            num_model=config.parallel.num_model,
+            devices=surviving_devices(dead_hosts),
+        )
+    elif config.parallel.num_data is None:
         # slice-aware layout: on multi-slice deployments the data axis
         # orders ICI-adjacent chips together so grad psum rides ICI first
         mesh = create_multislice_mesh(num_model=config.parallel.num_model)
@@ -180,6 +251,32 @@ def _train_impl(
         config.workdir, keep=config.checkpoint_keep, save_interval=1,
         async_save=config.checkpoint_async,
     )
+
+    def emergency_save(s, completed_epoch: int, reason: str, extra_fields=None) -> None:
+        """The shared save-first-die-second path: the watchdog stall,
+        the fatal-alert abort, the graceful-preemption (SIGTERM) exit,
+        and the elastic rescale all funnel through here — one durable
+        mid-epoch checkpoint with the standard resume extras plus the
+        exit reason. Skips (not re-saves) a step that is already
+        durable; always blocks until the write lands."""
+        if int(s.step) in ckpt.all_steps():
+            print(
+                f"{reason}: step {int(s.step)} already durable, "
+                "skipping emergency save", flush=True,
+            )
+            return
+        extra = {
+            "epoch": completed_epoch,
+            "config": config_to_dict(config),
+            "num_data": num_data,
+            "emergency": True,
+            "reason": reason,
+        }
+        if extra_fields:
+            extra.update(extra_fields)
+        ckpt.save(int(s.step), s, extra=extra, force=True)
+        ckpt.wait()
+
     start_epoch = 0
     if ckpt.latest_step() is not None:  # --resume semantics, automatic
 
@@ -452,13 +549,27 @@ def _train_impl(
     # an in-band event line (Prometheus per-rule gauge rides it).
     engine = (
         AlertEngine(
-            parse_rules(config.alert_rules),
+            parse_rules(config.alert_rules, heartbeat_timeout=config.heartbeat_timeout),
             workdir=config.workdir,
             process_index=pidx,
         )
         if config.alert_rules and config.alert_rules != "none"
         else None
     )
+    # Elastic loop trigger (parallel/elastic.py): heartbeat-staleness
+    # detection + the rescale-consensus barrier, checked on log steps.
+    # Already-rescaled-away hosts are known_dead — their stale files
+    # stay in the workdir (obs_report's merged heartbeat table names
+    # them) and must not re-trigger.
+    elastic_coord: Optional[ElasticCoordinator] = None
+    if config.elastic:
+        elastic_coord = ElasticCoordinator(
+            config.workdir,
+            process_index=pidx,
+            num_processes=jax.process_count(),
+            timeout=config.heartbeat_timeout,
+            known_dead=dead_hosts,
+        )
 
     def handle_alerts(gstep: int, epoch: int, fired: list) -> None:
         """Write in-band alert event lines; under --alerts-fatal, make
@@ -477,27 +588,65 @@ def _train_impl(
             )
         writer.fsync()
         if config.alerts_fatal:
+            # with elastic on, heartbeat loss is HANDLED (checkpoint +
+            # rescale), not fatal: the abort would preempt the rescale
+            # the same observation is about to trigger
+            fatal = [
+                a for a in fired
+                if not (config.elastic and a.get("kind") == "heartbeat")
+            ]
+            if not fatal:
+                return
             # emergency checkpoint of the last known-finite state (the
             # fault-tolerance layer's save-first-die-second path)
-            s = guard["good_state"]
-            if int(s.step) not in ckpt.all_steps():
-                ckpt.save(
-                    int(s.step), s,
-                    extra={
-                        "epoch": epoch - 1,  # mid-epoch semantics (see watchdog)
-                        "config": config_to_dict(config),
-                        "num_data": num_data,
-                        "emergency": True,
-                        "alert": fired[0]["rule"],
-                    },
-                    force=True,
-                )
-                ckpt.wait()
+            emergency_save(
+                guard["good_state"], epoch - 1,  # mid-epoch semantics (see watchdog)
+                "alert", {"alert": fatal[0]["rule"]},
+            )
             raise FatalAlertError(
-                f"aborting on fired alert(s) {[a['rule'] for a in fired]} at step "
+                f"aborting on fired alert(s) {[a['rule'] for a in fatal]} at step "
                 f"{gstep} (--alerts-fatal); emergency checkpoint saved — see "
                 f"{engine.path} and {writer.path}"
             )
+
+    def elastic_rescale(gstep: int, epoch: int, dead_now: list) -> None:
+        """The elastic loop's commit point: agree on the event with the
+        surviving peers, make the emergency checkpoint durable, emit the
+        schema'd rescale event line, then raise ElasticRescale for the
+        outer loop to rebuild the world on the surviving mesh."""
+        all_dead = sorted(set(dead_hosts) | set(dead_now))
+        plan, new_ref, info = plan_rescale(
+            ref_config, num_data, config.parallel.num_model, all_dead, gstep
+        )
+        print0(
+            f"elastic: hosts {dead_now} lost heartbeat (> "
+            f"{config.heartbeat_timeout:g}s stale) at step {gstep}; proposing "
+            f"mesh {plan.old_num_data} -> {plan.new_num_data}"
+        )
+        plan = elastic_coord.agree(plan)
+        rescale_extra = {**plan.consensus_key(), "step": plan.step}
+        for k in ("kappa", "lr", "momentum"):
+            if k in info:
+                rescale_extra[k] = float(info[k])
+        emergency_save(
+            guard["good_state"], epoch - 1,  # mid-epoch: redo this epoch
+            "rescale", {"rescale": rescale_extra},
+        )
+        line = {
+            "epoch": epoch,
+            "event": "rescale",
+            "rescale/dead_hosts": list(plan.dead_hosts),
+            "rescale/old_num_data": plan.old_num_data,
+            "rescale/new_num_data": plan.new_num_data,
+            "rescale/old_global_batch": plan.old_global_batch,
+            "rescale/new_global_batch": plan.new_global_batch,
+        }
+        for k in ("kappa", "lr", "momentum"):
+            if k in info:
+                line[f"rescale/{k}"] = float(info[k])
+        writer.write(gstep, line)
+        writer.fsync()  # the rescale must leave its event on disk
+        raise ElasticRescale(plan, new_ref, info)
     # Step-time breakdown probe + windowed profiler (obs/stepstats.py,
     # utils/metrics.py): both keyed on the host-side global step counter.
     probe = StepTimeProbe(config.obs_probe_every)
@@ -533,27 +682,10 @@ def _train_impl(
 
             def _save():
                 try:
-                    s = guard["good_state"]
-                    if int(s.step) in ckpt.all_steps():
-                        print(
-                            f"watchdog: step {int(s.step)} already durable, "
-                            "skipping emergency save", flush=True,
-                        )
-                        return
-                    ckpt.save(
-                        int(s.step), s,
-                        extra={
-                            # mid-epoch semantics, like the preemption path:
-                            # the current epoch is NOT complete, resume
-                            # redoes it from the start
-                            "epoch": guard["epoch"] - 1,
-                            "config": config_to_dict(config),
-                            "num_data": num_data,
-                            "emergency": True,
-                        },
-                        force=True,
-                    )
-                    ckpt.wait()
+                    # mid-epoch semantics, like the preemption path: the
+                    # current epoch is NOT complete, resume redoes it
+                    # from the start
+                    emergency_save(guard["good_state"], guard["epoch"] - 1, "stall")
                     print("watchdog: emergency checkpoint saved", flush=True)
                 except Exception as e:
                     print(f"watchdog: emergency checkpoint failed: {e!r}", flush=True)
@@ -638,6 +770,12 @@ def _train_impl(
                         m["loss"] = faults.corrupt_loss(m["loss"], gstep)
                         faults.maybe_stall(gstep)
                         faults.maybe_preempt(gstep)
+                        # kill@host: sudden host death (exit in a real
+                        # fleet; a stale simulated heartbeat on the
+                        # fake-fleet mesh — the elastic chaos harness)
+                        faults.maybe_kill_host(
+                            gstep, config.workdir, pidx, jax.process_count()
+                        )
                     if not math.isfinite(m["loss"]):
                         # non-finite-loss guard: skip the poisoned
                         # update (params/opt/queue roll back to the
@@ -776,6 +914,14 @@ def _train_impl(
                         handle_alerts(
                             gstep, epoch, engine.observe(gstep, payload)
                         )
+                    if elastic_coord is not None:
+                        # heartbeat-staleness check (off the hot path:
+                        # log steps only, file reads). A newly lost host
+                        # commits the rescale: consensus -> emergency
+                        # checkpoint -> event line -> ElasticRescale.
+                        dead_now = elastic_coord.stale_hosts()
+                        if dead_now:
+                            elastic_rescale(gstep, epoch, dead_now)
                     if schedule_sanitizer is not None:
                         # publish + cross-check AFTER the line is
                         # durable: a divergence abort must leave the
@@ -884,12 +1030,24 @@ def _train_impl(
                 # to a SIGKILL: the save happens within one step of the
                 # signal, inside a preemption grace window).
                 completed_epoch = epoch - 1 if stop_now else epoch
-                due = (
-                    stop_now
-                    or epoch == config.optim.epochs - 1
+                if stop_now:
+                    # Graceful preemption (SIGTERM — how preemptible VMs
+                    # announce reclamation — or Ctrl-C): the same
+                    # emergency-checkpoint path as the watchdog/alert/
+                    # rescale exits (save first, durable before exit),
+                    # plus an in-band event line naming the exit.
+                    writer.write(gstep_host, {"epoch": epoch, "event": "preempt"})
+                    emergency_save(state, completed_epoch, "preempt")
+                    writer.fsync()  # the metrics tail must be durable too
+                    print0(
+                        f"preempted mid-epoch {epoch}: state saved at step "
+                        f"{int(state.step)}; resume will redo epoch {epoch}"
+                    )
+                    break
+                if (
+                    epoch == config.optim.epochs - 1
                     or epoch % config.checkpoint_every_epochs == 0
-                )
-                if due:
+                ):
                     ckpt.save(
                         int(state.step),
                         state,
@@ -904,14 +1062,6 @@ def _train_impl(
                             "num_data": num_data,
                         },
                     )
-                if stop_now:
-                    ckpt.wait()  # the preemption save must be durable before exit
-                    writer.fsync()  # ...and so must the metrics tail
-                    print0(
-                        f"preempted mid-epoch {epoch}: state saved at step "
-                        f"{int(state.step)}; resume will redo epoch {epoch}"
-                    )
-                    break
     finally:
         if gatherer is not None:
             gatherer.close()  # join the gather worker; drop a parked result

@@ -327,6 +327,31 @@ class TrainConfig:
     # allocating a fresh batch-sized buffer. Ignored (harmless) on
     # backends without donation support (CPU).
     prefetch_donate: bool = False
+    # -- elastic training (parallel/elastic.py) -------------------------
+    # Heartbeat-triggered checkpoint-and-rescale: on heartbeat loss the
+    # survivors agree on the event (rescale-consensus barrier), take an
+    # emergency checkpoint, rebuild a smaller mesh over the surviving
+    # devices, reshard params/optimizer/queue onto it (reshard_state),
+    # re-derive momentum/LR from the shrunk global batch via the
+    # auto-scale rule, and resume in-process — no restart from scratch.
+    # Requires num_model == 1.
+    elastic: bool = False
+    # Heartbeat-staleness threshold in seconds: a host whose out-of-band
+    # heartbeat file is older than this is declared lost — by the alert
+    # engine's default heartbeat_loss rule AND (with elastic=True) the
+    # rescale trigger. Replaces the previously hard-coded 120 s in the
+    # alert default spec.
+    heartbeat_timeout: float = 120.0
+    # Principled batch scaling ("How to Scale Your EMA", arXiv:2307.13813;
+    # Momentum² Teacher, arXiv:2101.07525), spec "ref_batch=N": treat
+    # optim.lr and moco.momentum as REFERENCE values at global batch N
+    # and derive the live values from the actual global batch with
+    # κ = global_batch / N — LR linearly (lr·κ), the EMA momentum as
+    # m^κ. Warmup needs no re-derivation: warmup_epochs is
+    # epoch-denominated, and steps-per-epoch already shifts with the
+    # batch. "" disables; elastic runs default it to the original batch
+    # so a rescale re-derives against the pre-loss anchor.
+    auto_scale: str = ""
 
 
 def config_to_dict(cfg: TrainConfig) -> dict:
@@ -368,10 +393,66 @@ def config_from_dict(d: dict) -> TrainConfig:
                 "strict_tracing", "recompile_warmup_steps", "sanitize_collectives",
                 "sinks", "metrics_port", "metrics_host", "health_metrics",
                 "obs_probe_every", "fleet_metrics", "alert_rules", "alerts_fatal",
+                "device_prefetch", "prefetch_depth", "prefetch_donate",
+                "elastic", "heartbeat_timeout", "auto_scale",
             )
             if k in d
         },
     )
+
+
+def parse_auto_scale(spec: str) -> Optional[int]:
+    """Parse the `--auto-scale` spec ("ref_batch=N"); None when unset.
+    Same colon-separated key=val shape as the fault/alert grammars so a
+    future key (e.g. a BN-statistics-momentum rule) extends in place."""
+    if not spec:
+        return None
+    ref_batch: Optional[int] = None
+    for tok in spec.split(":"):
+        tok = tok.strip()
+        if not tok:
+            continue
+        k, _, v = tok.partition("=")
+        if k == "ref_batch":
+            ref_batch = int(v)
+        else:
+            raise ValueError(f"unknown auto-scale param {k!r} in {spec!r}")
+    if ref_batch is None or ref_batch <= 0:
+        raise ValueError(f"auto-scale spec {spec!r} needs ref_batch=<positive int>")
+    return ref_batch
+
+
+def apply_auto_scale(config: TrainConfig) -> Tuple[TrainConfig, Optional[dict]]:
+    """Derive the LIVE hyperparameters from the reference ones under the
+    batch-scaling rules: κ = global_batch / ref_batch, lr' = lr·κ
+    (linear), EMA momentum m' = m^κ (the EMA scaling rule — shrinking
+    the batch by κ<1 must SLOW the key encoder's drift per step or it
+    decouples from the query encoder; arXiv:2307.13813 §3). Identity
+    (config, None) when no auto_scale spec is set.
+
+    Always derives from the values IN `config` — callers that rescale
+    repeatedly (the elastic loop) must pass the reference config each
+    time, never an already-derived one."""
+    ref_batch = parse_auto_scale(config.auto_scale)
+    if ref_batch is None:
+        return config, None
+    kappa = config.data.global_batch / ref_batch
+    lr = config.optim.lr * kappa
+    momentum = config.moco.momentum**kappa
+    derived = dataclasses.replace(
+        config,
+        optim=dataclasses.replace(config.optim, lr=lr),
+        moco=dataclasses.replace(config.moco, momentum=momentum),
+    )
+    info = {
+        "ref_batch": ref_batch,
+        "kappa": kappa,
+        "lr": lr,
+        "momentum": momentum,
+        "ref_lr": config.optim.lr,
+        "ref_momentum": config.moco.momentum,
+    }
+    return derived, info
 
 
 class ResumeCompatError(ValueError):

@@ -36,6 +36,24 @@ Spec grammar: comma-separated faults, each `kind@key=val[:key=val...]`:
                                   signature) — exercises the runtime
                                   collective-schedule sanitizer without
                                   a real divergent pod
+    kill@host=i[:at=K]             host i dies at global step K (default:
+                                  the first step observed). In a real
+                                  multi-process fleet the faulted
+                                  process stops beating and exits
+                                  immediately (exit code KILL_EXIT_CODE,
+                                  no checkpoint — sudden death, not a
+                                  graceful preemption); on a
+                                  single-process fake-fleet mesh the
+                                  harness instead stamps simulated host
+                                  i's out-of-band heartbeat file
+                                  (heartbeat.p<i>.json) with an
+                                  infinitely stale timestamp, so the
+                                  survivors' REAL staleness-detection
+                                  path (obs/fleet.py heartbeats ->
+                                  parallel/elastic.py) observes the
+                                  loss deterministically — the elastic
+                                  checkpoint-and-rescale chaos harness
+                                  (scripts/elastic_smoke.py)
     slow@site=S:ms=X[:at=K:times=M]
                                   sleep X *milliseconds* on calls
                                   K..K+M-1 (1-based; default: every
@@ -71,9 +89,14 @@ import time
 from collections import Counter
 from typing import Optional
 
-KINDS = ("ckpt_truncate", "io", "nan", "stall", "preempt", "delay", "diverge", "slow")
+KINDS = ("ckpt_truncate", "io", "nan", "stall", "preempt", "delay", "diverge", "slow", "kill")
 
-_INT_KEYS = ("step", "at", "times")
+# Exit code of a kill@host-faulted process in a real multi-process fleet
+# (distinct from the watchdog's 42): sudden death the survivors must
+# detect via heartbeat staleness, not a graceful shutdown.
+KILL_EXIT_CODE = 113
+
+_INT_KEYS = ("step", "at", "times", "host")
 _FLOAT_KEYS = ("seconds", "ms")
 _STR_KEYS = ("site",)
 
@@ -106,6 +129,8 @@ class FaultPlan:
                     kv[k] = v
                 else:
                     raise ValueError(f"unknown fault param {k!r} in {part!r}")
+            if kind == "kill" and "host" not in kv:
+                raise ValueError(f"kill fault {part!r} needs host=<process index>")
             self.rules.append((kind, kv))
         self._lock = threading.Lock()
         self._io_counts: Counter = Counter()  # site -> reads seen
@@ -177,6 +202,48 @@ class FaultPlan:
             if kind == "preempt" and p["step"] == step and self._fire_once(i):
                 print(f"injected fault: SIGTERM self at step {step}", flush=True)
                 os.kill(os.getpid(), signal.SIGTERM)
+
+    def maybe_kill_host(
+        self, step: int, workdir: str, process_index: int, num_processes: int = 1
+    ) -> None:
+        """`kill@host=i[:at=K]` — deterministic host loss for the elastic
+        chaos harness. Multi-process fleet: the faulted process stops
+        beating and exits with KILL_EXIT_CODE (sudden death). Single
+        process (fake-fleet simulation, one virtual device per "host"):
+        stamp simulated host i's heartbeat file with an infinitely stale
+        timestamp so the survivors' real staleness detection fires."""
+        for i, (kind, p) in enumerate(self.rules):
+            if kind != "kill" or step < p.get("at", 1):
+                continue
+            host = p["host"]
+            if num_processes > 1:
+                if process_index == host and self._fire_once(i):
+                    print(
+                        f"injected fault: killing host {host} (this process) "
+                        f"at step {step}",
+                        flush=True,
+                    )
+                    os._exit(KILL_EXIT_CODE)  # no beats, no cleanup: sudden death
+            elif self._fire_once(i):
+                # same filename convention as obs/fleet.py heartbeat_path
+                # (kept inline: this module stays stdlib-only); time=0.0
+                # is "stale since the epoch" — deterministic, no sleeping
+                import json
+
+                path = os.path.join(workdir, f"heartbeat.p{host}.json")
+                tmp = path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(
+                        {"process": host, "host": f"killed@step={step}",
+                         "pid": 0, "time": 0.0, "step": int(step), "epoch": 0},
+                        f,
+                    )
+                os.replace(tmp, path)
+                print(
+                    f"injected fault: simulated host {host} stopped beating "
+                    f"at step {step}",
+                    flush=True,
+                )
 
     def diverge_marker(self, site: str) -> str:
         """Non-empty divergence marker when a `diverge@site=S` rule
@@ -288,6 +355,13 @@ def maybe_stall(step: int) -> None:
 def maybe_preempt(step: int) -> None:
     if _PLAN is not None:
         _PLAN.maybe_preempt(step)
+
+
+def maybe_kill_host(
+    step: int, workdir: str, process_index: int, num_processes: int = 1
+) -> None:
+    if _PLAN is not None:
+        _PLAN.maybe_kill_host(step, workdir, process_index, num_processes)
 
 
 def diverge_marker(site: str) -> str:

@@ -1,0 +1,240 @@
+#!/usr/bin/env python
+"""Elastic-training chaos smoke: deterministic `kill@host` on a fake-8
+mesh must trigger the full checkpoint-and-rescale loop — detection →
+consensus → emergency checkpoint → reshard → rescale → in-process
+resume — with loss continuity against an uninterrupted control.
+
+    python scripts/elastic_smoke.py [--workdir DIR]
+
+(The script pins an 8-virtual-device CPU platform itself; each virtual
+device doubles as a simulated "host" — the FleetAggregator's
+one-device-per-host convention.)
+
+Two ZeRO-2/3 driver runs on the same seed:
+
+  control  uninterrupted fake-8 run (3 epochs × 2 steps, batch 64)
+  chaos    same config + `--elastic`, with `kill@host=2:at=3` injected:
+           simulated host 2 stops beating at global step 3
+
+The chaos run must, without a from-scratch restart:
+
+1. fire the `heartbeat_loss` alert (obs/alerts.py default rule at the
+   configurable `--heartbeat-timeout`) AND the elastic trigger on the
+   same stale heartbeat;
+2. agree on the rescale (consensus file published), take an emergency
+   checkpoint whose extras carry `reason: "rescale"` + the plan;
+3. emit a schema'd `event: "rescale"` metrics line with the old/new mesh
+   shape (8 → 4: the widest surviving width preserving the queue's
+   `K % global_batch == 0` invariant at constant per-device batch) and
+   the re-derived hyperparameters (κ = 1/2: LR halves, EMA momentum
+   becomes m^κ — "How to Scale Your EMA", arXiv:2307.13813);
+4. reshard the ZeRO flat shards onto the 4-wide mesh through the
+   layout-aware resume (`reshard_state`), visible as the per-device
+   at-rest state footprint DOUBLING across the rescale;
+5. finish all epochs in-process with a final-epoch loss within
+   tolerance of the control.
+
+CI runs this in the tier-1 job and uploads metrics.jsonl, alerts.jsonl,
+the heartbeat files (the dead host's stale one included), and the
+summary as artifacts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+EPOCHS = 3
+SPE = 2  # steps per epoch (pinned, so the schedule is batch-independent)
+KILL_STEP = 3  # global step at which simulated host 2 stops beating
+KILL_HOST = 2
+# 8 hosts, per-device batch 8, K=128: the widest surviving width with
+# 128 % (8·n) == 0 at n <= 7 is n = 4 (see elastic.feasible_width)
+EXPECT_NEW_NUM_DATA = 4
+LOSS_TOL = 0.10  # relative final-epoch loss tolerance vs the control
+
+
+def _config(workdir: str, elastic: bool):
+    from moco_tpu.utils.config import (
+        DataConfig,
+        MocoConfig,
+        OptimConfig,
+        ParallelConfig,
+        TrainConfig,
+    )
+
+    return TrainConfig(
+        moco=MocoConfig(
+            arch="resnet18", dim=16, num_negatives=128, momentum=0.99,
+            temperature=0.2, mlp=True, shuffle="none", cifar_stem=True,
+            compute_dtype="float32",
+        ),
+        optim=OptimConfig(lr=0.03, epochs=EPOCHS, cos=True),
+        data=DataConfig(dataset="synthetic", image_size=16, global_batch=64, num_workers=2),
+        # ZeRO-2/3: the rescale must route the persistent flat shards
+        # through reshard_state, not just replicated params
+        parallel=ParallelConfig(num_data=8, shard_weight_update=True, zero_stage=3),
+        workdir=workdir,
+        log_every=1,
+        steps_per_epoch=SPE,
+        checkpoint_keep=0,  # keep every step: the rescale save is inspected
+        obs_probe_every=2,
+        fleet_metrics=True,
+        alert_rules="default",
+        elastic=elastic,
+        heartbeat_timeout=5.0,
+    )
+
+
+def run_control(workdir: str) -> dict:
+    from moco_tpu.data.datasets import SyntheticDataset
+    from moco_tpu.train import train
+
+    return train(
+        _config(workdir, elastic=False),
+        dataset=SyntheticDataset(num_examples=4 * 64, image_size=16),
+    )
+
+
+def run_chaos(workdir: str) -> dict:
+    from moco_tpu.data.datasets import SyntheticDataset
+    from moco_tpu.train import train
+    from moco_tpu.utils import faults
+
+    faults.install(f"kill@host={KILL_HOST}:at={KILL_STEP}")
+    try:
+        return train(
+            _config(workdir, elastic=True),
+            dataset=SyntheticDataset(num_examples=4 * 64, image_size=16),
+        )
+    finally:
+        faults.clear()
+
+
+def _read_jsonl(path):
+    with open(path) as f:
+        return [json.loads(l) for l in f if l.strip()]
+
+
+def assert_surface(workdir: str, result: dict, control: dict) -> dict:
+    from moco_tpu.obs import schema
+    from moco_tpu.utils.checkpoint import CheckpointManager
+
+    metrics_path = os.path.join(workdir, "metrics.jsonl")
+    errors = schema.validate_file(metrics_path)
+    assert not errors, f"schema violations: {errors[:5]}"
+    records = schema.read_metrics(metrics_path)
+
+    # -- 1. the run finished all epochs in-process, losses finite -------
+    assert result["epoch"] == EPOCHS - 1, f"chaos run ended at epoch {result['epoch']}"
+    train_lines = [r for r in records if "loss" in r and "event" not in r]
+    assert all(r["loss"] is not None for r in train_lines), "non-finite loss logged"
+
+    # -- 2. exactly one rescale event with the derived plan -------------
+    rescales = [r for r in records if r.get("event") == "rescale"]
+    assert len(rescales) == 1, f"expected 1 rescale event, got {len(rescales)}"
+    ev = rescales[0]
+    assert ev["rescale/dead_hosts"] == [KILL_HOST], ev
+    assert ev["rescale/old_num_data"] == 8, ev
+    assert ev["rescale/new_num_data"] == EXPECT_NEW_NUM_DATA, ev
+    assert ev["rescale/old_global_batch"] == 64, ev
+    assert ev["rescale/new_global_batch"] == 8 * EXPECT_NEW_NUM_DATA, ev
+    kappa = ev["rescale/kappa"]
+    assert abs(kappa - 0.5) < 1e-9, f"kappa {kappa} != 0.5"
+    # the EMA-scaling rule: momentum re-derives as m^kappa, LR linearly
+    assert abs(ev["rescale/momentum"] - 0.99**0.5) < 1e-9, ev
+    assert abs(ev["rescale/lr"] - 0.03 * 0.5) < 1e-9, ev
+
+    # -- 3. the heartbeat_loss alert fired on the same staleness --------
+    alerts = _read_jsonl(os.path.join(workdir, "alerts.jsonl"))
+    assert any(a["rule"] == "heartbeat_loss" for a in alerts), alerts
+    assert os.path.exists(os.path.join(workdir, f"heartbeat.p{KILL_HOST}.json")), (
+        "dead host's stale heartbeat file missing — the merged-heartbeat "
+        "table could not name it"
+    )
+    assert os.path.exists(os.path.join(workdir, "rescale.p0.json")), (
+        "no consensus file published"
+    )
+
+    # -- 4. the emergency checkpoint carries the rescale reason + plan --
+    mgr = CheckpointManager(workdir, keep=0)
+    extras = {s: mgr.read_extra(s) for s in mgr.all_steps()}
+    rescue = [e for e in extras.values() if e.get("reason") == "rescale"]
+    assert rescue, f"no rescale emergency checkpoint: { {s: e.get('reason') for s, e in extras.items()} }"
+    plan = rescue[0]["rescale"]
+    assert plan["dead_hosts"] == [KILL_HOST] and plan["new_num_data"] == EXPECT_NEW_NUM_DATA
+    # the final checkpoint was written by the SURVIVING mesh
+    final_extra = extras[max(extras)]
+    assert final_extra["num_data"] == EXPECT_NEW_NUM_DATA, final_extra
+    assert final_extra["epoch"] == EPOCHS - 1, final_extra
+    mgr.close()
+    assert not os.path.isdir(os.path.join(workdir, "quarantine")), (
+        "the rescale resume quarantined a checkpoint — the reshard path "
+        "misread a layout change as corruption"
+    )
+
+    # -- 5. the reshard is visible: per-device at-rest state doubles ----
+    rescale_step = ev["step"]
+    pre = [r for r in train_lines if r["step"] <= rescale_step]
+    post = [r for r in train_lines if r["step"] > rescale_step]
+    assert len(post) >= 2 * SPE, f"only {len(post)} post-rescale training lines"
+    s_pre, s_post = pre[-1]["hbm_state_bytes"], post[-1]["hbm_state_bytes"]
+    assert s_post > 1.5 * s_pre, (
+        f"per-device state {s_pre} -> {s_post}: the 8->4 reshard should "
+        "roughly double the flat-shard footprint"
+    )
+
+    # -- 6. loss continuity vs the uninterrupted control ----------------
+    rel = abs(result["loss"] - control["loss"]) / abs(control["loss"])
+    assert rel <= LOSS_TOL, (
+        f"post-rescale final-epoch loss {result['loss']:.4f} deviates "
+        f"{rel:.1%} from control {control['loss']:.4f} (> {LOSS_TOL:.0%})"
+    )
+    return {
+        "rescale_event": ev,
+        "final_loss": result["loss"],
+        "control_loss": control["loss"],
+        "loss_rel_dev": rel,
+        "state_bytes_pre": s_pre,
+        "state_bytes_post": s_post,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description="elastic checkpoint-and-rescale chaos smoke")
+    ap.add_argument("--workdir", default=None, help="default: a fresh temp dir")
+    args = ap.parse_args()
+    base = args.workdir or tempfile.mkdtemp(prefix="elastic_smoke_")
+    control_dir = os.path.join(base, "control")
+    chaos_dir = os.path.join(base, "chaos")
+    os.makedirs(control_dir, exist_ok=True)
+    os.makedirs(chaos_dir, exist_ok=True)
+
+    control = run_control(control_dir)
+    chaos = run_chaos(chaos_dir)
+    summary = assert_surface(chaos_dir, chaos, control)
+    with open(os.path.join(base, "elastic_smoke.json"), "w") as f:
+        json.dump(summary, f, indent=2)
+    print(
+        f"elastic smoke OK: mesh 8 -> {EXPECT_NEW_NUM_DATA} at step "
+        f"{summary['rescale_event']['step']}, final loss "
+        f"{summary['final_loss']:.4f} vs control {summary['control_loss']:.4f} "
+        f"({summary['loss_rel_dev']:.1%} dev) — artifacts in {base}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -41,6 +41,9 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."
 
 DEFAULT_BLOCK = 512  # rows per POST: bounds request size and replica compiles
 
+# injectable for tests (a flaky replica is simulated by swapping this)
+_urlopen = urllib.request.urlopen
+
 
 def fresh_rows(queue: np.ndarray, old_ptr, new_ptr: int) -> np.ndarray:
     """The block the trainer enqueued since the last sighting, in FIFO
@@ -58,17 +61,29 @@ def fresh_rows(queue: np.ndarray, old_ptr, new_ptr: int) -> np.ndarray:
 
 def post_rows(server: str, rows: np.ndarray, block: int = DEFAULT_BLOCK) -> int:
     """POST `rows` to the replica's /ingest in bounded blocks; returns
-    the replica's reported index row count after the last block."""
-    index_rows = -1
-    for lo in range(0, rows.shape[0], block):
-        chunk = np.ascontiguousarray(rows[lo : lo + block], np.float32)
+    the replica's reported index row count after the last block.
+
+    Each POST runs through the `utils/retry.py` backoff layer (site
+    `ingest.post`, counted in the per-site io_retries ledger): a replica
+    restart or transient connection reset mid-tail degrades to a logged
+    retry instead of dropping the ingest block — `urllib`'s URLError is
+    an OSError, so the default retry_on covers both network and HTTP
+    transport failures."""
+    from moco_tpu.utils import retry
+
+    def _post(chunk: np.ndarray) -> int:
         req = urllib.request.Request(
             server.rstrip("/") + "/ingest",
             data=chunk.tobytes(),
             headers={"X-Rows-Shape": f"{chunk.shape[0]},{chunk.shape[1]}"},
         )
-        with urllib.request.urlopen(req, timeout=60) as r:
-            index_rows = json.loads(r.read())["index_rows"]
+        with _urlopen(req, timeout=60) as r:
+            return json.loads(r.read())["index_rows"]
+
+    index_rows = -1
+    for lo in range(0, rows.shape[0], block):
+        chunk = np.ascontiguousarray(rows[lo : lo + block], np.float32)
+        index_rows = retry.retry_call(_post, chunk, site="ingest.post")
     return index_rows
 
 
@@ -107,9 +122,16 @@ def main() -> int:
     ap.add_argument("--block", type=int, default=DEFAULT_BLOCK, help="rows per /ingest POST")
     ap.add_argument("--once", action="store_true", help="one poll, then exit (smoke/test mode)")
     args = ap.parse_args()
+    from moco_tpu.utils import retry
+
     seen: dict = {}
     while True:
         poll_once(args.ckpt_dir, args.server, seen, args.block)
+        retries = retry.snapshot()
+        if retries:
+            # the per-site retry ledger (ingest.post + checkpoint-restore
+            # sites), surfaced like the train driver's io_retries field
+            print(f"io_retries: {json.dumps(retries)}", flush=True)
         if args.once:
             return 0
         time.sleep(args.poll_s)

@@ -156,8 +156,39 @@ def test_hooks_are_noops_when_disabled():
     faults.maybe_io_error("anywhere")
     faults.maybe_stall(1)
     faults.maybe_preempt(1)
+    faults.maybe_kill_host(1, "/nonexistent", 0)
     assert faults.corrupt_loss(2.0, 1) == 2.0
     faults.on_checkpoint_saved("/nonexistent", 1)
+
+
+# -- kill@host (elastic chaos harness) -----------------------------------
+def test_kill_grammar_requires_host():
+    faults.install("kill@host=2:at=5")
+    assert faults.describe() == [("kill", {"host": 2, "at": 5})]
+    faults.clear()
+    with pytest.raises(ValueError, match="host"):
+        faults.install("kill@at=5")
+    with pytest.raises(ValueError):
+        faults.install("kill@host=2:replica=1")  # unknown param
+
+
+def test_kill_stamps_stale_heartbeat_once(tmp_path):
+    """Single-process fake-fleet semantics: the fault stamps simulated
+    host i's heartbeat file with an infinitely stale timestamp — once —
+    and only from the trigger step onward."""
+    import json
+    import os
+
+    faults.install("kill@host=3:at=4")
+    faults.maybe_kill_host(3, str(tmp_path), 0, 1)  # before the trigger
+    assert not os.path.exists(tmp_path / "heartbeat.p3.json")
+    faults.maybe_kill_host(4, str(tmp_path), 0, 1)
+    rec = json.load(open(tmp_path / "heartbeat.p3.json"))
+    assert rec["process"] == 3 and rec["time"] == 0.0
+    # fire-once: a later beat by a revived simulation is not re-stamped
+    (tmp_path / "heartbeat.p3.json").write_text(json.dumps({"process": 3, "time": 1e12}))
+    faults.maybe_kill_host(5, str(tmp_path), 0, 1)
+    assert json.load(open(tmp_path / "heartbeat.p3.json"))["time"] == 1e12
 
 
 # -- watchdog ------------------------------------------------------------

@@ -265,6 +265,92 @@ def test_reshard_state_layout_roundtrips():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_reshard_state_unequal_mesh_widths():
+    """The elastic-rescale conversion path (ISSUE 12): a checkpoint's
+    (n, m) flat shards restore onto a NARROWER, non-divisor mesh width —
+    8 -> 5 -> 3 — through the flat-vector converter, bit-for-bit. The
+    queue rows, pointer, and batch stats pass through untouched (they
+    are replicated, width-independent), and every opt-state leaf lands
+    exactly as a directly-created state of the target width would."""
+    widths = (8, 5, 3)
+    cfg = {n: _config(zero=True, stage=3) for n in widths}
+    encoder = build_encoder(cfg[8].moco, num_data=8)
+    tx = build_optimizer(cfg[8].optim, steps_per_epoch=4)
+    sample = jnp.zeros((1, IMG, IMG, 3), jnp.float32)
+    rng = jax.random.PRNGKey(0)
+    s_rep = create_state(rng, _config(zero=False), encoder, tx, sample)
+    states = {
+        n: create_state(rng, cfg[n], encoder, tx, sample, zero_num_data=n)  # mocolint: disable=JX003  (same seed on purpose: every width must hold identical values for the bitwise cross-width comparison)
+        for n in widths
+    }
+    # make the queue content distinctive so "passes through" is a real check
+    marked = jnp.arange(states[8].queue.size, dtype=jnp.float32).reshape(
+        states[8].queue.shape
+    )
+    states = {
+        n: s.replace(queue=marked, queue_ptr=jnp.asarray(7, jnp.int32))
+        for n, s in states.items()
+    }
+
+    def assert_matches(converted, target):
+        for name in ("params_q", "params_k", "opt_state"):
+            for a, b in zip(
+                jax.tree.leaves(getattr(converted, name)),
+                jax.tree.leaves(getattr(target, name)),
+            ):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(
+            np.asarray(converted.queue), np.asarray(target.queue)
+        )
+        assert int(converted.queue_ptr) == int(target.queue_ptr)
+
+    down_5 = reshard_state(states[8], live_template=states[5], full_template=s_rep)
+    assert_matches(down_5, states[5])
+    down_3 = reshard_state(down_5, live_template=states[3], full_template=s_rep)
+    assert_matches(down_3, states[3])
+    # and back out to replicated: the full roundtrip loses nothing
+    back = reshard_state(down_3, live_template=s_rep, full_template=s_rep)
+    for a, b in zip(jax.tree.leaves(back.params_q), jax.tree.leaves(s_rep.params_q)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_embedding_index_rows_survive_width_shrink():
+    """The dictionary side of the elastic shrink: EmbeddingIndex rows
+    carried on an 8-wide mesh land bitwise-identical on a 5-wide (then
+    3-wide) mesh, the valid-count mask still hides the capacity padding
+    (which differs per width), and top-k retrieval returns the same
+    neighbors after the move."""
+    from moco_tpu.serve.index import EmbeddingIndex
+
+    rng = np.random.default_rng(0)
+    dim, valid = 16, 50
+    rows = rng.standard_normal((valid, dim)).astype(np.float32)
+    rows /= np.linalg.norm(rows, axis=1, keepdims=True)
+    queries = rows[:4] + 0.01 * rng.standard_normal((4, dim)).astype(np.float32)
+    queries = (queries / np.linalg.norm(queries, axis=1, keepdims=True)).astype(
+        np.float32
+    )
+
+    results = {}
+    for n in (8, 5, 3):
+        mesh = create_mesh(num_data=n, num_model=1, devices=jax.devices()[:n])
+        idx = EmbeddingIndex(capacity=valid + 3, dim=dim, mesh=mesh)
+        # capacity pads up to the axis width, differently per width
+        assert idx.capacity % n == 0 and idx.capacity >= valid + 3
+        idx.snapshot(rows)
+        assert idx.count == valid  # the valid-count mask, not the padding
+        stored = np.asarray(idx.rows)[:valid]
+        np.testing.assert_array_equal(stored, rows)  # bitwise row preservation
+        assert not np.any(np.asarray(idx.rows)[valid:])  # padding stays zero
+        idx.prepare(buckets=(4,), k=5)
+        idx.freeze()
+        _, ids = idx.query(queries, k=5)
+        assert (ids < valid).all(), f"width {n} returned padded/invalid rows: {ids}"
+        results[n] = ids
+    np.testing.assert_array_equal(results[8], results[5])
+    np.testing.assert_array_equal(results[5], results[3])
+
+
 def test_zero23_eval_gather_matches_replicated_init():
     """The eval-side one-shot gather (unshard_tree_host): a freshly
     created stage-2/3 state gathers back to exactly the replicated

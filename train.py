@@ -172,6 +172,30 @@ def build_parser() -> argparse.ArgumentParser:
         "collective",
     )
     p.add_argument(
+        "--elastic", action="store_true", default=None,
+        help="elastic training (parallel/elastic.py): on heartbeat loss "
+        "the survivors agree on the event, take an emergency checkpoint, "
+        "rebuild a smaller mesh, reshard params/optimizer/queue onto it, "
+        "re-derive momentum/LR from the shrunk global batch (m^kappa / "
+        "linear), and resume in-process — no restart from scratch "
+        "(requires --num-model 1)",
+    )
+    p.add_argument(
+        "--heartbeat-timeout", type=float, default=None,
+        help="heartbeat-staleness threshold in seconds for declaring a "
+        "host lost (the alert engine's heartbeat_loss rule AND the "
+        "elastic rescale trigger; default 120). Must exceed the "
+        "worst-case wall time between log steps",
+    )
+    p.add_argument(
+        "--auto-scale", default=None, metavar="ref_batch=N",
+        help="principled batch scaling (arXiv:2307.13813): treat --lr "
+        "and --moco-m as reference values at global batch N and derive "
+        "the live values from the actual batch (kappa = batch/N: lr "
+        "linear, EMA momentum m^kappa). Elastic runs default this to "
+        "the original batch so a rescale re-derives against it",
+    )
+    p.add_argument(
         "--faults", default=None,
         help="deterministic fault-injection spec (chaos testing), e.g. "
         "'ckpt_truncate@step=8,io@site=data.read:at=3,nan@step=6' — "
@@ -364,6 +388,9 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         fleet_metrics=args.fleet_metrics,
         alert_rules=args.alert_rules,
         alerts_fatal=args.alerts_fatal,
+        elastic=args.elastic,
+        heartbeat_timeout=args.heartbeat_timeout,
+        auto_scale=args.auto_scale,
         device_prefetch=args.device_prefetch,
         prefetch_depth=args.prefetch_depth,
         prefetch_donate=args.prefetch_donate,
