@@ -46,6 +46,19 @@ JX010 interprocedural sharding consistency — a HELPER-issued
 JX011 input-wire thread hygiene — threads started without
       join-on-close; blocking `put` on a bounded queue with no
       poison-pill/timeout path (the PR-5 producer-leak shape)
+JX012 shared mutable attribute written without a common lock
+      across its accessing threads — thread-escape analysis over
+      Thread targets, HTTP handler methods (one thread per
+      request), and callback escapes, with lock-sets inherited
+      through always-under-lock helpers (analysis/threads.py)
+JX013 lock-order cycles (lock A held while B is acquired, and
+      elsewhere the inverse — the static deadlock) and blocking
+      calls under a held lock (queue put/get with no timeout,
+      `Event.wait()`, `urlopen`, `time.sleep`, device syncs)
+JX014 AOT freeze discipline — a shape not derived from the
+      registered bucket table reaching an unguarded
+      `jit`/`lower().compile()` seam in a freeze-disciplined
+      class: the `EngineRecompileError` class, caught statically
 ====  =========================================================
 
 Since v2 the engine is a real analysis stack: `analysis/callgraph.py`
@@ -85,7 +98,21 @@ The runtime arm complements the static pass inside the train driver:
   every peer, aborting with a per-site diff — and a
   `collective_schedule_hash` metrics field — BEFORE a schedule mismatch
   can deadlock the pod. `diverge@site=S` (`utils/faults.py`) injects a
-  deterministic divergence for CI (`scripts/sanitizer_smoke.py`).
+  deterministic divergence for CI (`scripts/sanitizer_smoke.py`);
+- `--sanitize-threads` (`analysis/tsan.py`): every lock built by the
+  injectable `tsan.make_lock(name)` factory (serve.index,
+  serve.metrics, obs.*, data.*) reports its acquisition order to a
+  per-thread recorder; an order CYCLE — two paths nesting the same
+  locks opposite ways, tomorrow's wedged replica — aborts (or, in the
+  serving smokes, records) with BOTH acquisition stacks in
+  `lock_order_diff.json`, and a stdlib profile hook logs blocking ops
+  issued while a lock is held. `deadlock@site=<lock>` forces an
+  inverted acquisition order at the tagged lock for the CI proof
+  (the serve_smoke `--sanitize-threads` chaos leg).
+
+`mocolint --changed <git-ref>` lints only the files differing from the
+ref (plus untracked ones) — the fast CI pre-pass; the full
+baseline-gated run stays the authoritative gate.
 """
 
 from __future__ import annotations

@@ -67,7 +67,43 @@ def build_parser() -> argparse.ArgumentParser:
         help="(re)write the baseline file from this run's findings "
         "instead of failing on them",
     )
+    p.add_argument(
+        "--changed", metavar="GIT_REF", default=None,
+        help="lint only files that differ from GIT_REF (plus untracked "
+        "ones) inside the given paths — the fast CI pre-pass. NOTE: the "
+        "interprocedural summaries then see only the changed subset, so "
+        "the full baseline-gated run remains the gate; this one just "
+        "fails earlier",
+    )
     return p
+
+
+def changed_files(ref: str, paths: list[str]) -> list[str]:
+    """Python files under `paths` that differ from `ref` (per
+    `git diff --name-only`, deletions excluded) or are untracked."""
+    import subprocess
+
+    from moco_tpu.analysis.engine import iter_python_files
+
+    def _git(*args: str) -> list[str]:
+        out = subprocess.run(
+            ["git", *args], capture_output=True, text=True, check=True
+        ).stdout
+        return [l.strip() for l in out.splitlines() if l.strip()]
+
+    top = _git("rev-parse", "--show-toplevel")[0]
+    changed = set(
+        _git("diff", "--name-only", "--diff-filter=d", ref, "--")
+        + _git("ls-files", "--others", "--exclude-standard")
+    )
+    import os
+
+    changed_abs = {os.path.normpath(os.path.join(top, c)) for c in changed}
+    return [
+        f
+        for f in iter_python_files(paths)
+        if os.path.normpath(os.path.abspath(f)) in changed_abs
+    ]
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -84,6 +120,23 @@ def main(argv: list[str] | None = None) -> int:
         if unknown:
             print(f"mocolint: unknown rule(s): {', '.join(sorted(unknown))}", file=sys.stderr)
             return 2
+    paths = args.paths
+    if args.changed is not None:
+        import subprocess
+
+        try:
+            paths = changed_files(args.changed, paths)
+        except (subprocess.CalledProcessError, OSError, IndexError) as e:
+            print(f"mocolint: cannot resolve --changed {args.changed!r}: {e}",
+                  file=sys.stderr)
+            return 2
+        if not paths:
+            print(f"mocolint: no python files changed vs {args.changed}")
+            return 0
+        print(
+            f"mocolint: --changed {args.changed}: linting "
+            f"{len(paths)} file(s)"
+        )
     baseline_path = None
     if not args.no_baseline:
         baseline_path = args.baseline or discover_baseline(args.paths)
@@ -102,7 +155,7 @@ def main(argv: list[str] | None = None) -> int:
         except (OSError, ValueError) as e:
             print(f"mocolint: cannot read baseline {baseline_path}: {e}", file=sys.stderr)
             return 2
-    findings = analyze_paths(args.paths, rules=rules, baseline=baseline)
+    findings = analyze_paths(paths, rules=rules, baseline=baseline)
     report = (
         render_json(findings)
         if args.format == "json"

@@ -14,4 +14,7 @@ from moco_tpu.analysis.rules import (  # noqa: F401
     jx009_mixed_precision,
     jx010_sharding_consistency,
     jx011_thread_hygiene,
+    jx012_shared_state,
+    jx013_lock_order,
+    jx014_aot_freeze,
 )

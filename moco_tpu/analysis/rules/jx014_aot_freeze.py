@@ -1,0 +1,238 @@
+"""JX014 — AOT freeze discipline: request-derived shapes must not reach
+an unguarded compile seam.
+
+The serving perf story depends on zero recompiles after warmup: the
+engine AOT-compiles one executable per registered padded bucket
+(`jit(...).lower(shape).compile()`), `freeze()` marks the table closed,
+and any shape that would need a fresh trace must raise
+(`EngineRecompileError` / the index's flavor) instead of silently
+compiling on live traffic. Today that is enforced at RUNTIME — the pod
+equivalent of "catch it before the hang". This rule catches the class
+statically: in a freeze-disciplined class (one that assigns
+``self._frozen`` or defines ``freeze``/``mark_warm``), a flow path where
+a shape **not derived from the registered bucket table** reaches a
+compile seam that is **not frozen-guarded** is a finding.
+
+Vocabulary (deliberately approximate, near-zero false positives):
+
+- *compile seam*: a ``.lower(...).compile()`` chain, a ``jax.jit(...)``
+  call, or a call to an intra-class method that transitively contains
+  one;
+- *bucket-derived* (clean): iteration over / subscripts of a
+  ``buckets``-named attribute, the result of ``bucket_for(...)``, and
+  constants;
+- *raw* (dirty): a method parameter, anything computed from one —
+  crucially ``param.shape[...]`` — i.e. request-shaped data;
+- *frozen-guarded*: the seam-carrying method opens with
+  ``if self._frozen: raise ...`` (the engine's `_compile` idiom) — the
+  runtime guard IS the discipline, so guarded seams are clean.
+
+The known-bad shape::
+
+    def run(self, images):                  # images: live request
+        b = images.shape[0]
+        if b not in self._compiled:
+            self._compiled[b] = jit(f).lower(images).compile()   # JX014
+
+and the clean one pads to ``self.bucket_for(b)`` first or guards the
+seam with the frozen check.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from moco_tpu.analysis.astutils import ModuleContext, walk_own
+from moco_tpu.analysis.engine import rule
+
+_BUCKET_SANITIZERS = ("bucket_for",)
+
+
+def _is_freeze_disciplined(cls: ast.ClassDef) -> bool:
+    for node in ast.walk(cls):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name in ("freeze", "mark_warm"):
+                return True
+        if isinstance(node, ast.Attribute) and node.attr in ("_frozen", "frozen"):
+            if isinstance(node.ctx, ast.Store):
+                return True
+    return False
+
+
+def _has_frozen_guard(fn: ast.FunctionDef) -> bool:
+    """Does the method body raise under a `self._frozen`-style test?"""
+    for node in walk_own(fn):
+        if isinstance(node, ast.If):
+            mentions_frozen = any(
+                isinstance(n, ast.Attribute) and n.attr in ("_frozen", "frozen")
+                for n in ast.walk(node.test)
+            )
+            if mentions_frozen and any(
+                isinstance(b, ast.Raise) for b in ast.walk(ast.Module(body=node.body, type_ignores=[]))
+            ):
+                return True
+    return False
+
+
+def _is_jit_qual(q: Optional[str]) -> bool:
+    return q in ("jax.jit", "jax.pjit") or (q or "").endswith((".jit", ".pjit"))
+
+
+def _contains_compile_seam(
+    ctx: ModuleContext, fn: ast.FunctionDef
+) -> Optional[tuple[ast.Call, list[ast.AST]]]:
+    """(seam call, shape-bearing argument exprs) inside `fn`, if any.
+
+    Three spellings: ``<jit obj>.lower(shapes).compile()`` (shapes ride
+    the inner lower), ``jit(f)(x)`` immediate invocation (shapes are the
+    outer args), and a bare ``jit(...)`` whose result escapes (no shape
+    args here — the per-call trace happens wherever it is called, which
+    is exactly the hazard; the seam itself is the finding anchor)."""
+    for node in walk_own(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr == "compile":
+            inner = func.value
+            if isinstance(inner, ast.Call) and isinstance(inner.func, ast.Attribute) \
+                    and inner.func.attr == "lower":
+                return node, list(inner.args) + [kw.value for kw in inner.keywords]
+        if isinstance(func, ast.Call) and _is_jit_qual(ctx.qual(func.func)):
+            return node, list(node.args) + [kw.value for kw in node.keywords]
+    return None
+
+
+def _is_bucket_expr(ctx: ModuleContext, expr: ast.AST, raw: set[str]) -> bool:
+    """True when the expression is provably bucket-table-derived."""
+    if isinstance(expr, ast.Constant):
+        return True
+    if isinstance(expr, ast.Call):
+        func = expr.func
+        name = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else None
+        )
+        if name in _BUCKET_SANITIZERS:
+            return True
+    if isinstance(expr, ast.Subscript):
+        return _is_bucket_expr(ctx, expr.value, raw)
+    if isinstance(expr, ast.Attribute) and "bucket" in expr.attr.lower():
+        return True
+    if isinstance(expr, ast.Name) and "bucket" in expr.id.lower() and expr.id not in raw:
+        return True
+    return False
+
+
+def _raw_names_in(expr: ast.AST, raw: set[str]) -> bool:
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Name) and n.id in raw:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr == "shape":
+            # .shape of anything non-bucket inside a seam argument is a
+            # raw dynamic shape by definition
+            inner = n.value
+            if not (isinstance(inner, ast.Attribute) and "bucket" in inner.attr.lower()):
+                return True
+    return False
+
+
+@rule("JX014", "request-derived shape reaching an unguarded jit/lower().compile() seam after freeze()")
+def check(ctx: ModuleContext):
+    for cls in ctx.tree.body:
+        if not isinstance(cls, ast.ClassDef) or not _is_freeze_disciplined(cls):
+            continue
+        methods = {
+            n.name: n
+            for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        # seam carriers: methods containing a compile seam, split by
+        # whether the frozen guard protects them
+        guarded: set[str] = set()
+        unguarded_seams: dict[str, tuple[ast.Call, list[ast.AST]]] = {}
+        for name, fn in methods.items():
+            seam = _contains_compile_seam(ctx, fn)
+            if seam is None:
+                continue
+            if _has_frozen_guard(fn):
+                guarded.add(name)
+            else:
+                unguarded_seams[name] = seam
+        # helpers invoked intra-class are judged at their CALL SITES: a
+        # carrier like `_compile(bucket)` is clean in itself — whether
+        # `bucket` is raw depends on what each caller passes
+        called_intra: set[str] = set()
+        for fn in methods.values():
+            for node in walk_own(fn):
+                if isinstance(node, ast.Call):
+                    f = node.func
+                    if (
+                        isinstance(f, ast.Attribute)
+                        and isinstance(f.value, ast.Name)
+                        and f.value.id == "self"
+                    ):
+                        called_intra.add(f.attr)
+                    elif isinstance(f, ast.Name):
+                        called_intra.add(f.id)
+        for name, fn in methods.items():
+            if name == "__init__":
+                # construction happens before freeze() by definition
+                continue
+            params = {
+                p.arg
+                for p in [*fn.args.posonlyargs, *fn.args.args, *fn.args.kwonlyargs]
+                if p.arg != "self"
+            }
+            raw = set() if name in called_intra else set(params)
+            seam_here = name in unguarded_seams
+            for node in sorted(
+                walk_own(fn),
+                key=lambda n: (getattr(n, "lineno", 0), getattr(n, "col_offset", 0)),
+            ):
+                # thread rawness through simple assignments
+                if isinstance(node, ast.Assign):
+                    dirty = _raw_names_in(node.value, raw) and not _is_bucket_expr(
+                        ctx, node.value, raw
+                    )
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            if dirty:
+                                raw.add(t.id)
+                            else:
+                                raw.discard(t.id)
+                if isinstance(node, ast.For) and isinstance(node.target, ast.Name):
+                    if _is_bucket_expr(ctx, node.iter, raw):
+                        raw.discard(node.target.id)
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                callee = None
+                if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name) \
+                        and func.value.id == "self":
+                    callee = func.attr
+                elif isinstance(func, ast.Name):
+                    callee = func.id
+                is_seam_call = callee in unguarded_seams and callee != name
+                is_direct_seam = seam_here and node is unguarded_seams[name][0]
+                if not (is_seam_call or is_direct_seam):
+                    continue
+                if is_direct_seam:
+                    args = unguarded_seams[name][1]
+                else:
+                    args = [*node.args, *[kw.value for kw in node.keywords]]
+                for arg in args:
+                    if _is_bucket_expr(ctx, arg, raw):
+                        continue
+                    if _raw_names_in(arg, raw):
+                        yield node, (
+                            f"shape not derived from the bucket table reaches "
+                            f"compile seam "
+                            f"{'self.' + callee if is_seam_call else 'jit/lower().compile()'} "
+                            f"in freeze-disciplined class {cls.name} with no "
+                            "frozen guard — after freeze() this traces on live "
+                            "traffic (the EngineRecompileError class, caught "
+                            "statically); pad through bucket_for()/the bucket "
+                            "table or guard the seam with `if self._frozen: "
+                            "raise`"
+                        )
+                        break

@@ -613,6 +613,10 @@ class ImageFolderDataset:
         labels = np.empty(bs, np.int32)
 
         def one(row):
+            # returns the failure count for this row instead of bumping
+            # self.decode_failures from 8 pool threads at once — `+=` is
+            # a read-modify-write, and concurrent workers lose updates
+            # (JX012); the caller aggregates single-threaded below
             i = int(idx[row])
             path, label = self.samples[i]
             labels[row] = label
@@ -631,7 +635,8 @@ class ImageFolderDataset:
                         )
                         out[row, c] = np.asarray(crop, np.uint8)
             except Exception:
-                self.decode_failures += 1  # slot stays zero, but COUNTED
+                return 1  # slot stays zero, but COUNTED (by the caller)
+            return 0
 
         if pool is None:
             from concurrent.futures import ThreadPoolExecutor
@@ -639,7 +644,7 @@ class ImageFolderDataset:
             if not hasattr(self, "_crop_pool"):
                 self._crop_pool = ThreadPoolExecutor(max_workers=8)
             pool = self._crop_pool
-        list(pool.map(one, range(bs)))
+        self.decode_failures += sum(pool.map(one, range(bs)))
         return out, labels
 
 

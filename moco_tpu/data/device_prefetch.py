@@ -47,6 +47,8 @@ from typing import Callable, Iterator, Optional
 from moco_tpu.obs.trace import counter as obs_counter, span as obs_span
 from moco_tpu.utils import faults
 
+from moco_tpu.analysis import tsan
+
 # fault-injection site for the wire (`delay@site=input.h2d:seconds=S`):
 # the overlap tests and `scripts/overlap_smoke.py` slow the transfer
 # stage deterministically through this hook
@@ -98,7 +100,8 @@ class TransferStats:
     """Thread-safe per-batch + cumulative transfer accounting."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        # tsan factory (analysis/tsan.py): traced under --sanitize-threads
+        self._lock = tsan.make_lock("data.transfer_stats")
         self.t_transfer: Optional[float] = None  # seconds, last batch
         self.transfer_bytes: Optional[int] = None  # wire bytes, last batch
         self.depth_live: int = 0  # staged batches ready right now

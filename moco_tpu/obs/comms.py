@@ -67,6 +67,8 @@ import jax
 
 from moco_tpu.analysis import sanitizer as _schedule
 
+from moco_tpu.analysis import tsan
+
 COLLECTIVES = (
     "all_gather",
     "all_to_all",
@@ -145,7 +147,7 @@ class CommSite:
         return self.bytes_per_call * self.calls_per_step
 
 
-_LOCK = threading.Lock()
+_LOCK = tsan.make_lock("obs.comms")  # traced under --sanitize-threads
 _LEDGER: dict[str, CommSite] = {}
 
 

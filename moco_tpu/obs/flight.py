@@ -31,6 +31,8 @@ import time
 from collections import deque
 from typing import Optional
 
+from moco_tpu.analysis import tsan
+
 DEFAULT_MAX_REQUESTS = 512
 DEFAULT_MAX_METRICS = 120
 DEFAULT_TOP_N = 10
@@ -47,7 +49,8 @@ class FlightRecorder:
         replica: int = 0,
     ):
         self.replica = int(replica)
-        self._lock = threading.Lock()
+        # tsan factory (analysis/tsan.py): traced under --sanitize-threads
+        self._lock = tsan.make_lock("obs.flight")
         self._requests: deque = deque(maxlen=int(max_requests))
         self._metrics: deque = deque(maxlen=int(max_metrics))
         self._dump_seq = itertools.count()

@@ -38,6 +38,8 @@ from typing import Callable, Optional
 import jax
 import numpy as np
 
+from moco_tpu.analysis import tsan
+
 # Single indirection point for the batched transfer, so tests can count
 # calls without monkeypatching jax itself.
 _DEVICE_GET = jax.device_get
@@ -272,7 +274,9 @@ class PrometheusSink(Sink):
     lock."""
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1", prefix: str = "moco"):
-        self._lock = threading.Lock()
+        # tsan factory (analysis/tsan.py): scrape-handler threads and the
+        # writer contend here — --sanitize-threads smoke runs trace it
+        self._lock = tsan.make_lock("obs.prometheus")
         self._gauges: dict[str, float] = {}
         self._events: dict[str, int] = {}
         # histogram-shaped payload values ({"le", "counts", "sum",

@@ -31,6 +31,8 @@ import threading
 from collections import deque
 from typing import Optional, Sequence
 
+from moco_tpu.analysis import tsan
+
 # (fast, slow) windows, seconds. Burn thresholds below are the classic
 # multiwindow pair scaled to these: sustained burn > the threshold on
 # the fast window pages quickly; the slow window catches slow leaks.
@@ -63,7 +65,8 @@ class SLOBurnTracker:
         self.budget = 1.0 - self.objective
         self.windows = tuple(sorted(int(w) for w in windows))
         self._max_w = self.windows[-1]
-        self._lock = threading.Lock()
+        # tsan factory (analysis/tsan.py): traced under --sanitize-threads
+        self._lock = tsan.make_lock("obs.slo")
         # per-second [sec, good, bad] buckets, oldest left; pruned on
         # record so memory is bounded by the longest window
         self._buckets: deque = deque()

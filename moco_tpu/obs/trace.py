@@ -39,6 +39,8 @@ import threading
 import time
 from typing import Optional
 
+from moco_tpu.analysis import tsan
+
 
 class _NullSpan:
     """Reusable no-op context manager — the zero-cost path when no
@@ -95,7 +97,8 @@ class Tracer:
         max_spans: int = 200_000,
         process_index: int = 0,
     ):
-        self._lock = threading.Lock()
+        # tsan factory (analysis/tsan.py): traced under --sanitize-threads
+        self._lock = tsan.make_lock("obs.trace")
         self._local = threading.local()
         self._spans: list[dict] = []
         self._dropped = 0

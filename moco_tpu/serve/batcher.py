@@ -51,6 +51,7 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from moco_tpu.analysis import tsan
 from moco_tpu.obs.reqtrace import RequestIdAllocator, RequestTrace
 from moco_tpu.utils import faults
 
@@ -130,7 +131,10 @@ class ServeMetrics:
         latency_buckets_ms=DEFAULT_LATENCY_BUCKETS_MS,
     ):
         self.slo_ms = float(slo_ms)
-        self._lock = threading.Lock()
+        # tsan factory: the serving gauges' lock is the INNER lock of the
+        # sanctioned serve.index -> serve.metrics nesting (server.stats);
+        # --sanitize-threads smoke runs watch its acquisition order
+        self._lock = tsan.make_lock("serve.metrics")
         self._latencies_ms: deque = deque(maxlen=window)
         self._recalls: deque = deque(maxlen=window)
         self._bucket_counts: dict[int, int] = {}

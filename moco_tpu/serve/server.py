@@ -78,6 +78,7 @@ from moco_tpu.obs.reqtrace import RequestIdAllocator, emit_request_spans
 from moco_tpu.obs.sinks import resolve_serve_port  # noqa: F401  (public API)
 from moco_tpu.obs.slo import DEFAULT_WINDOWS, SLOBurnTracker, serve_alert_spec
 from moco_tpu.obs.trace import Tracer, get_tracer
+from moco_tpu.analysis import tsan
 from moco_tpu.serve.batcher import BatcherClosedError, ContinuousBatcher, ServeMetrics
 from moco_tpu.serve.index import QUERY_MODES
 from moco_tpu.utils import faults
@@ -180,8 +181,10 @@ class ServeServer:
         self._neighbor_flushes = 0
         self.ingested_rows = 0
         # one lock covers every index touch: a donated ingest write must
-        # never invalidate a rows buffer a query is reading mid-flight
-        self._index_lock = threading.Lock()
+        # never invalidate a rows buffer a query is reading mid-flight.
+        # tsan factory (analysis/tsan.py) so --sanitize-threads smoke
+        # runs see its acquisition order; zero-cost otherwise
+        self._index_lock = tsan.make_lock("serve.index")
         if warmup:
             engine.warmup()
             if index is not None:
@@ -299,28 +302,34 @@ class ServeServer:
                         n, d = (int(s) for s in shape_hdr.split(","))
                     except ValueError:
                         raise ValueError(f"bad X-Rows-Shape header {shape_hdr!r}")
-                    if d != server.index.dim:
-                        raise ValueError(
-                            f"row dim {d} != index dim {server.index.dim}"
-                        )
                     length = int(self.headers.get("Content-Length", 0))
                     if length != n * d * 4:
                         raise ValueError(
                             f"Content-Length {length} != n*d*4 = {n * d * 4}"
                         )
+                    # the socket read stays OUTSIDE the lock (JX013: no
+                    # blocking I/O under _index_lock); the dim check and
+                    # the response counters move INSIDE it so concurrent
+                    # ingests can't interleave a torn snapshot (JX012)
                     rows = np.frombuffer(
                         self.rfile.read(length), np.float32
                     ).reshape(n, d)
                     with server._index_lock:
+                        if d != server.index.dim:
+                            raise ValueError(
+                                f"row dim {d} != index dim {server.index.dim}"
+                            )
                         server.index.add(rows)
                         server.ingested_rows += n
+                        index_rows = server.index.count
+                        total_ingested = server.ingested_rows
                 except ValueError as e:
                     self._json(400, {"error": str(e)})
                     return
                 self._json(200, {
                     "ingested": n,
-                    "index_rows": server.index.count,
-                    "total_ingested": server.ingested_rows,
+                    "index_rows": index_rows,
+                    "total_ingested": total_ingested,
                 })
 
             def _read_images(self) -> np.ndarray:
@@ -436,7 +445,7 @@ class ServeServer:
             except IndexError:
                 break
             emit_request_spans(self._tracer, trace, self._lane)
-            self._lane += 1
+            self._lane += 1  # mocolint: disable=JX012  (flusher-thread only during the run; close() joins the flusher BEFORE its final _write_metrics call, so the two writers are join-serialized, never concurrent)
 
     def _on_alert(self, alert: dict) -> None:
         """AlertEngine on_fire hook: an SLO-burn (or any serving) alert
@@ -488,6 +497,16 @@ class ServeServer:
     # -- metrics ---------------------------------------------------------
 
     def stats(self) -> dict:
+        # the whole snapshot sits under _index_lock so the gauge line is
+        # CONSISTENT: index_rows/ingested_rows/ivf gauges can't interleave
+        # with a concurrent /ingest mid-read (JX012). This nests
+        # serve.index -> serve.metrics (payload takes the metrics lock
+        # inside) — the one sanctioned order; tsan's runtime order graph
+        # watches it and the deadlock@site chaos leg inverts it on purpose.
+        with self._index_lock:
+            return self._stats_locked()
+
+    def _stats_locked(self) -> dict:
         out = self.metrics.payload()
         out["serve/recompiles_after_warmup"] = self.engine.recompiles_after_warmup
         # retrieval-tier gauges: which path answers /neighbors by default
@@ -531,7 +550,7 @@ class ServeServer:
         the flight ring + alert engine (a fired rule dumps the ring via
         `_on_alert`), render pending request spans, then fan the line
         out to the sink."""
-        self._flush_step += 1
+        self._flush_step += 1  # mocolint: disable=JX012  (same join-serialization as _lane: the alert hook fires ON the flusher thread, and close() joins the flusher before the final flush — one writer at a time by construction)
         try:
             payload = self.stats()
             self.flight.record_metrics(self._flush_step, payload)

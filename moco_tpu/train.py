@@ -406,6 +406,19 @@ def _train_impl(
         )
         _prev_recorder = install_recorder(schedule_sanitizer.recorder)
 
+    # Lock-order sanitizer (mocolint v3 runtime arm, analysis/tsan.py):
+    # every tsan-factory lock reports acquisition order; a cycle aborts
+    # with both stacks (strict — the ScheduleDivergenceError posture)
+    # before a lock inversion can wedge the process, and the run report
+    # (lock_order.json) lands next to the schedule files on close.
+    thread_sanitizer = None
+    if config.sanitize_threads:
+        from moco_tpu.analysis.tsan import ThreadSanitizer
+
+        thread_sanitizer = ThreadSanitizer(
+            workdir=config.workdir, strict=True, profile=True
+        )
+
     # Graceful preemption (TPU VMs are frequently preemptible, typically
     # with a ~30 s SIGTERM grace window): the flag is checked inside the
     # STEP loop, so the save happens within seconds, not at the end of a
@@ -1069,6 +1082,8 @@ def _train_impl(
             from moco_tpu.analysis.sanitizer import install_recorder
 
             install_recorder(_prev_recorder)
+        if thread_sanitizer is not None:
+            thread_sanitizer.close()  # restores hooks, writes lock_order.json
         if profile_window is not None:
             profile_window.close()  # stop a still-open capture window
         if wd is not None:

@@ -267,6 +267,16 @@ class TrainConfig:
     # (recording happens at trace time; the check piggybacks on the log
     # step's host sync).
     sanitize_collectives: bool = False
+    # Runtime lock-order sanitizer (mocolint v3 runtime arm,
+    # analysis/tsan.py, --sanitize-threads): every tsan-factory lock
+    # (serve.index, serve.metrics, obs.*, data.*) reports its
+    # acquisition order to a per-process recorder; an order cycle —
+    # two code paths nesting the same locks opposite ways — aborts
+    # with both acquisition stacks (lock_order_diff.json) BEFORE the
+    # deadlock wedges the process, and blocking ops issued under a
+    # held lock are recorded for the run report (lock_order.json).
+    # Smoke-run tooling: the profile hook costs real CPU.
+    sanitize_threads: bool = False
     # -- telemetry (moco_tpu/obs) ---------------------------------------
     # Metric sinks, comma list from the obs sink registry ("jsonl",
     # "csv", "tensorboard"); the JSONL sink is always included — the
@@ -391,6 +401,7 @@ def config_from_dict(d: dict) -> TrainConfig:
                 "checkpoint_async", "checkpoint_keep", "steps_per_epoch",
                 "nan_guard_threshold", "watchdog_timeout",
                 "strict_tracing", "recompile_warmup_steps", "sanitize_collectives",
+                "sanitize_threads",
                 "sinks", "metrics_port", "metrics_host", "health_metrics",
                 "obs_probe_every", "fleet_metrics", "alert_rules", "alerts_fatal",
                 "device_prefetch", "prefetch_depth", "prefetch_donate",

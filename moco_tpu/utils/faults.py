@@ -36,6 +36,19 @@ Spec grammar: comma-separated faults, each `kind@key=val[:key=val...]`:
                                   signature) — exercises the runtime
                                   collective-schedule sanitizer without
                                   a real divergent pod
+    deadlock@site=L               force an INVERTED lock-acquisition
+                                  order at tagged lock L: when the
+                                  tsan-traced lock named L is acquired
+                                  while another lock is held, the
+                                  lock-order recorder (analysis/tsan.py)
+                                  also records the edge the opposite
+                                  nesting would have produced, as if a
+                                  second thread raced the critical
+                                  section backwards — a deterministic
+                                  order cycle through the real
+                                  detection path, with no actual
+                                  deadlock risk (the serve_smoke
+                                  --sanitize-threads chaos leg)
     kill@host=i[:at=K]             host i dies at global step K (default:
                                   the first step observed). In a real
                                   multi-process fleet the faulted
@@ -89,7 +102,10 @@ import time
 from collections import Counter
 from typing import Optional
 
-KINDS = ("ckpt_truncate", "io", "nan", "stall", "preempt", "delay", "diverge", "slow", "kill")
+KINDS = (
+    "ckpt_truncate", "io", "nan", "stall", "preempt", "delay", "diverge",
+    "slow", "kill", "deadlock",
+)
 
 # Exit code of a kill@host-faulted process in a real multi-process fleet
 # (distinct from the watchdog's 42): sudden death the survivors must
@@ -245,6 +261,15 @@ class FaultPlan:
                     flush=True,
                 )
 
+    def deadlock_marker(self, site: str) -> bool:
+        """True when a `deadlock@site=L` rule targets this tsan lock
+        name — the lock-order recorder then records the inverted
+        acquisition edge too (see analysis/tsan.py)."""
+        for kind, p in self.rules:
+            if kind == "deadlock" and p.get("site") == site:
+                return True
+        return False
+
     def diverge_marker(self, site: str) -> str:
         """Non-empty divergence marker when a `diverge@site=S` rule
         targets this comms site — the schedule recorder appends it to
@@ -368,6 +393,12 @@ def diverge_marker(site: str) -> str:
     if _PLAN is not None:
         return _PLAN.diverge_marker(site)
     return ""
+
+
+def deadlock_marker(site: str) -> bool:
+    if _PLAN is not None:
+        return _PLAN.deadlock_marker(site)
+    return False
 
 
 def on_checkpoint_saved(directory: str, step: int, wait=None) -> None:

@@ -27,7 +27,9 @@ import time
 from collections import Counter
 from typing import Callable, Optional, Tuple, Type
 
-_lock = threading.Lock()
+from moco_tpu.analysis import tsan
+
+_lock = tsan.make_lock("utils.retry")  # traced under --sanitize-threads
 _retries: Counter = Counter()  # site -> number of retried failures
 _last_error: dict = {}  # site -> repr of the most recent retried error
 

@@ -66,7 +66,7 @@ EXPECT_NEW_NUM_DATA = 4
 LOSS_TOL = 0.10  # relative final-epoch loss tolerance vs the control
 
 
-def _config(workdir: str, elastic: bool):
+def _config(workdir: str, elastic: bool, sanitize_threads: bool = False):
     from moco_tpu.utils.config import (
         DataConfig,
         MocoConfig,
@@ -95,20 +95,25 @@ def _config(workdir: str, elastic: bool):
         alert_rules="default",
         elastic=elastic,
         heartbeat_timeout=5.0,
+        # mocolint v3 runtime arm: trace lock-acquisition order through
+        # the whole checkpoint-and-rescale storm (heartbeat writers,
+        # prefetch ring, async gatherer); a cycle aborts the run, a
+        # clean pass writes lock_order.json next to the schedule files
+        sanitize_threads=sanitize_threads,
     )
 
 
-def run_control(workdir: str) -> dict:
+def run_control(workdir: str, sanitize_threads: bool = False) -> dict:
     from moco_tpu.data.datasets import SyntheticDataset
     from moco_tpu.train import train
 
     return train(
-        _config(workdir, elastic=False),
+        _config(workdir, elastic=False, sanitize_threads=sanitize_threads),
         dataset=SyntheticDataset(num_examples=4 * 64, image_size=16),
     )
 
 
-def run_chaos(workdir: str) -> dict:
+def run_chaos(workdir: str, sanitize_threads: bool = False) -> dict:
     from moco_tpu.data.datasets import SyntheticDataset
     from moco_tpu.train import train
     from moco_tpu.utils import faults
@@ -116,7 +121,7 @@ def run_chaos(workdir: str) -> dict:
     faults.install(f"kill@host={KILL_HOST}:at={KILL_STEP}")
     try:
         return train(
-            _config(workdir, elastic=True),
+            _config(workdir, elastic=True, sanitize_threads=sanitize_threads),
             dataset=SyntheticDataset(num_examples=4 * 64, image_size=16),
         )
     finally:
@@ -215,6 +220,12 @@ def assert_surface(workdir: str, result: dict, control: dict) -> dict:
 def main() -> int:
     ap = argparse.ArgumentParser(description="elastic checkpoint-and-rescale chaos smoke")
     ap.add_argument("--workdir", default=None, help="default: a fresh temp dir")
+    ap.add_argument(
+        "--sanitize-threads", action="store_true",
+        help="run both legs under the mocolint v3 lock-order sanitizer "
+        "(strict: an order cycle anywhere in the rescale storm aborts); "
+        "asserts the clean lock_order.json artifact exists",
+    )
     args = ap.parse_args()
     base = args.workdir or tempfile.mkdtemp(prefix="elastic_smoke_")
     control_dir = os.path.join(base, "control")
@@ -222,9 +233,19 @@ def main() -> int:
     os.makedirs(control_dir, exist_ok=True)
     os.makedirs(chaos_dir, exist_ok=True)
 
-    control = run_control(control_dir)
-    chaos = run_chaos(chaos_dir)
+    control = run_control(control_dir, sanitize_threads=args.sanitize_threads)
+    chaos = run_chaos(chaos_dir, sanitize_threads=args.sanitize_threads)
     summary = assert_surface(chaos_dir, chaos, control)
+    if args.sanitize_threads:
+        # the runs completed (no LockOrderError) AND left their reports:
+        # the clean --sanitize-threads pass the CI leg asserts
+        for leg_dir in (control_dir, chaos_dir):
+            rep_path = os.path.join(leg_dir, "lock_order.json")
+            assert os.path.isfile(rep_path), f"missing {rep_path}"
+            with open(rep_path) as f:
+                rep = json.load(f)
+            assert not rep["cycles"], rep["cycles"]
+        summary["sanitize_threads"] = {"clean": True}
     with open(os.path.join(base, "elastic_smoke.json"), "w") as f:
         json.dump(summary, f, indent=2)
     print(

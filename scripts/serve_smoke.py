@@ -169,16 +169,34 @@ def make_toy_checkpoint(workdir: str):
     return config
 
 
-def run_smoke(workdir: str) -> dict:
+def run_smoke(workdir: str, sanitize_threads: bool = False) -> dict:
     """Boot → fire → tear down; returns the summary dict (also written
     to workdir/serve_smoke.json). Split from the assertions so tests
-    can reuse the run."""
+    can reuse the run.
+
+    `sanitize_threads` (mocolint v3, analysis/tsan.py) wraps the whole
+    run in a lock-order recorder — every tsan-factory lock's nesting is
+    traced, and the pass is CLEAN only with zero order cycles and the
+    sanctioned serve.index -> serve.metrics edge observed; then a chaos
+    leg re-boots a replica under `deadlock@site=serve.metrics` and
+    asserts the forced inversion IS caught, with the per-thread stack
+    diff artifact (lock_order_diff.json) dumped. Recording only — the
+    profile hook stays off here so the latency assertions stay honest.
+    """
     import numpy as np
 
     from moco_tpu.obs.sinks import JsonlSink
     from moco_tpu.serve.engine import InferenceEngine, load_serving_encoder
     from moco_tpu.serve.index import EmbeddingIndex
     from moco_tpu.serve.server import ServeServer
+
+    tsan_sanitizer = None
+    if sanitize_threads:
+        from moco_tpu.analysis.tsan import ThreadSanitizer
+
+        tsan_sanitizer = ThreadSanitizer(
+            workdir=workdir, strict=False, profile=False
+        )
 
     ckpt_dir = os.path.join(workdir, "toy_ckpt")
     make_toy_checkpoint(ckpt_dir)
@@ -266,8 +284,23 @@ def run_smoke(workdir: str) -> dict:
     # -- leg 8: w8a8 engine + fused IVF scan ----------------------------
     quant_summary = _quant_leg(ckpt_dir, engine, sink, canned)
 
+    # -- leg 9: thread sanitizer (mocolint v3) --------------------------
+    # clean report over everything above, then the deadlock@site chaos
+    # arm proving the detector catches a forced inversion end-to-end
+    tsan_summary = None
+    if tsan_sanitizer is not None:
+        clean = tsan_sanitizer.close()
+        tsan_summary = {
+            "acquisitions": clean["acquisitions"],
+            "edges": clean["edges"],
+            "cycles": len(clean["cycles"]),
+            "blocking_ops": len(clean["blocking_ops_under_lock"]),
+        }
+        tsan_summary["chaos"] = _tsan_chaos_leg(engine, index, workdir)
+
     sink.close()
     summary = {
+        "tsan": tsan_summary,
         "requests_sent": per_client * NUM_CLIENTS,
         "failures": failures,
         "smoke_slo_ms": SMOKE_SLO_MS,
@@ -282,6 +315,55 @@ def run_smoke(workdir: str) -> dict:
     with open(os.path.join(workdir, "serve_smoke.json"), "w") as f:
         json.dump(summary, f, indent=2)
     return summary
+
+
+def _tsan_chaos_leg(engine, index, workdir: str) -> dict:
+    """`deadlock@site=serve.metrics` chaos arm: re-boot a replica on the
+    already-warm engine, hit /stats once — the handler nests serve.index
+    -> serve.metrics (the sanctioned order), the fault records the
+    inverted edge as if a second thread raced it backwards, and the
+    recorder must catch the cycle and dump lock_order_diff.json with
+    BOTH acquisition stacks. Non-strict: serving keeps answering; the
+    artifact is the proof."""
+    from moco_tpu.analysis.tsan import ThreadSanitizer
+    from moco_tpu.serve.server import ServeServer
+    from moco_tpu.utils import faults
+
+    chaos_dir = os.path.join(workdir, "tsan_chaos")
+    os.makedirs(chaos_dir, exist_ok=True)
+    faults.install("deadlock@site=serve.metrics")
+    san = ThreadSanitizer(workdir=chaos_dir, strict=False, profile=False)
+    try:
+        server = ServeServer(
+            engine, index=index, port=0, warmup=False, metrics_flush_s=30.0,
+            reqtrace=False, alert_spec="",
+        )
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/stats", timeout=60
+            ) as r:
+                json.loads(r.read())
+        finally:
+            server.close()
+    finally:
+        report = san.close()
+        faults.clear()
+    diff_path = os.path.join(chaos_dir, "lock_order_diff.json")
+    diff = None
+    if os.path.isfile(diff_path):
+        with open(diff_path) as f:
+            diff = json.load(f)
+    return {
+        "cycles_caught": len(report["cycles"]),
+        "diff_path": diff_path if diff is not None else None,
+        "diff_cycle": (diff or {}).get("cycle"),
+        "diff_has_both_stacks": bool(diff) and all(
+            e.get("stack") for e in diff.get("edges", [])
+        ) and bool((diff or {}).get("acquiring", {}).get("stack")),
+        "injected_edges": sum(
+            1 for e in (diff or {}).get("edges", []) if e.get("injected")
+        ),
+    }
 
 
 def _ingest_leg(ckpt_dir: str, server, index) -> dict:
@@ -596,6 +678,23 @@ def _quant_leg(ckpt_dir: str, engine_f32, sink, canned) -> dict:
 def assert_serve_surface(workdir: str, summary: dict) -> None:
     from moco_tpu.obs import schema
 
+    # leg 9 (--sanitize-threads): the clean pass saw real lock traffic
+    # including the sanctioned serve.index -> serve.metrics nesting and
+    # recorded ZERO order cycles; the chaos arm's forced inversion was
+    # caught with a both-stacks diff artifact
+    tsan = summary.get("tsan")
+    if tsan is not None:
+        assert tsan["cycles"] == 0, f"lock-order cycles on the clean pass: {tsan}"
+        assert tsan["acquisitions"] > 0, "sanitizer saw no lock traffic"
+        edges = {(e["held"], e["acquired"]) for e in tsan["edges"]}
+        assert ("serve.index", "serve.metrics") in edges, (
+            f"sanctioned stats() nesting not observed: {sorted(edges)}"
+        )
+        chaos = tsan["chaos"]
+        assert chaos["cycles_caught"] >= 1, f"injected inversion not caught: {chaos}"
+        assert chaos["diff_path"] and chaos["diff_has_both_stacks"], chaos
+        assert chaos["injected_edges"] >= 1, chaos
+
     stats = summary["stats"]
     assert not summary["failures"], f"request failures: {summary['failures'][:5]}"
     assert stats["serve/requests"] >= summary["requests_sent"], stats
@@ -742,10 +841,17 @@ def main() -> int:
     pin_platform_from_env()  # honor JAX_PLATFORMS at the config level
     ap = argparse.ArgumentParser(description="embedding-service smoke")
     ap.add_argument("--workdir", default=None, help="default: a fresh temp dir")
+    ap.add_argument(
+        "--sanitize-threads", action="store_true",
+        help="mocolint v3 runtime arm: trace lock acquisition order over "
+        "the whole run (clean = zero cycles), then prove the detector on "
+        "a deadlock@site=serve.metrics chaos leg (lock_order_diff.json "
+        "with both stacks uploads as a CI artifact)",
+    )
     args = ap.parse_args()
     workdir = args.workdir or tempfile.mkdtemp(prefix="serve_smoke_")
     os.makedirs(workdir, exist_ok=True)
-    summary = run_smoke(workdir)
+    summary = run_smoke(workdir, sanitize_threads=args.sanitize_threads)
     assert_serve_surface(workdir, summary)
     s = summary["stats"]
     iv = summary["ivf"]["stats"]
@@ -767,8 +873,17 @@ def main() -> int:
         f"quant leg: w8a8 cos={summary['quant']['cosine_vs_f32']:.5f} "
         f"fused recall={summary['quant']['stats']['serve/recall_estimate']:.3f} "
         f"recompiles={summary['quant']['stats']['serve/recompiles_after_warmup']} "
-        f"spill={summary['quant']['stats']['serve/ivf_spill']} — "
-        f"artifacts in {workdir}"
+        f"spill={summary['quant']['stats']['serve/ivf_spill']}"
+        + (
+            " | tsan: {a} acquisitions, 0 cycles clean, chaos caught "
+            "{c} cycle(s)".format(
+                a=summary["tsan"]["acquisitions"],
+                c=summary["tsan"]["chaos"]["cycles_caught"],
+            )
+            if summary.get("tsan")
+            else ""
+        )
+        + f" — artifacts in {workdir}"
     )
     return 0
 

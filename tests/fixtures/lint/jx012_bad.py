@@ -1,0 +1,47 @@
+"""Known-bad fixture for JX012: shared mutable attributes written
+across threads with no common lock — the unlocked-counter /
+torn-snapshot shapes the serving stack grew in PRs 8-12."""
+
+import threading
+
+
+class UnlockedCounter:
+    """A flusher thread and the caller both bump a bare int."""
+
+    def __init__(self):
+        self._stop = threading.Event()
+        self.completed = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            self.completed += 1  # expect: JX012
+
+    def record(self):
+        self.completed += 1
+
+    def close(self):
+        self._stop.set()
+        self._thread.join()
+
+
+class HalfLockedStats:
+    """Writes hold the lock; the stats read skips it — the interleaved
+    /stats-vs-ingest snapshot shape."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.rows = 0
+        self._thread = threading.Thread(target=self._ingest, daemon=True)
+        self._thread.start()
+
+    def _ingest(self):
+        with self._lock:
+            self.rows += 1
+
+    def stats(self):
+        return {"rows": self.rows}  # expect: JX012
+
+    def close(self):
+        self._thread.join()

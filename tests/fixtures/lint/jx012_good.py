@@ -1,0 +1,51 @@
+"""Known-good fixture for JX012: every cross-thread attribute access
+holds the one lock; thread-safe primitives (queues, events) and
+single-thread attributes are not findings."""
+
+import queue
+import threading
+
+
+class LockedCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._q = queue.Queue()  # unbounded: JX011-clean too
+        self.completed = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            with self._lock:
+                self.completed += 1
+
+    def record(self):
+        with self._lock:
+            self.completed += 1
+
+    def stats(self):
+        with self._lock:
+            return {"completed": self.completed}
+
+    def close(self):
+        self._stop.set()
+        self._thread.join()
+
+
+class SingleThreadState:
+    """Written only on its own worker thread: one root, no finding."""
+
+    def __init__(self):
+        self._stop = threading.Event()
+        self._seen = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            self._seen += 1
+
+    def close(self):
+        self._stop.set()
+        self._thread.join()

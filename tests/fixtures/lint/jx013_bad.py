@@ -1,0 +1,33 @@
+"""Known-bad fixture for JX013: an AB/BA lock-order cycle between the
+ingest and stats paths, and a blocking queue put issued under a lock."""
+
+import queue
+import threading
+
+
+class DeadlockProne:
+    def __init__(self):
+        self._index_lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+        self._q = queue.Queue(maxsize=8)
+        self._thread = threading.Thread(target=self._ingest, daemon=True)
+        self._thread.start()
+
+    def _ingest(self):
+        # ingest path: index lock, then stats lock
+        with self._index_lock:
+            with self._stats_lock:
+                self.rows = 1
+
+    def stats(self):
+        # stats path: stats lock, then index lock — the inverted order
+        with self._stats_lock:
+            with self._index_lock:  # expect: JX013
+                return {"rows": self.rows}
+
+    def publish(self, item):
+        with self._index_lock:
+            self._q.put(item)  # expect: JX013
+
+    def close(self):
+        self._thread.join()

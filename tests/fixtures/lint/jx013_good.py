@@ -1,0 +1,41 @@
+"""Known-good fixture for JX013: one global acquisition order on both
+paths, and the blocking put moved outside the lock (with a timeout —
+the JX011 contract rides along)."""
+
+import queue
+import threading
+
+
+class OrderedLocks:
+    def __init__(self):
+        self._index_lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+        self._q = queue.Queue(maxsize=8)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._ingest, daemon=True)
+        self._thread.start()
+
+    def _ingest(self):
+        # both paths agree: index lock outermost
+        with self._index_lock:
+            with self._stats_lock:
+                self.rows = 1
+
+    def stats(self):
+        with self._index_lock:
+            with self._stats_lock:
+                return {"rows": self.rows}
+
+    def publish(self, item):
+        with self._index_lock:
+            payload = {"item": item, "rows": self.rows}
+        while not self._stop.is_set():
+            try:
+                self._q.put(payload, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+    def close(self):
+        self._stop.set()
+        self._thread.join()

@@ -1,0 +1,27 @@
+"""Known-bad fixture for JX014: a freeze-disciplined engine that lazily
+compiles whatever request shape arrives — after freeze() this traces on
+live traffic (the EngineRecompileError class, uncaught)."""
+
+import jax
+
+
+class LazyEngine:
+    def __init__(self, forward, buckets):
+        self.buckets = tuple(sorted(buckets))
+        self._compiled = {}
+        self._frozen = False
+
+    def freeze(self):
+        self._frozen = True
+
+    def bucket_for(self, n):
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(n)
+
+    def run(self, images):
+        b = images.shape[0]
+        if b not in self._compiled:
+            self._compiled[b] = jax.jit(self._fwd).lower(images).compile()  # expect: JX014
+        return self._compiled[b](images)

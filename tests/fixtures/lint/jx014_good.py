@@ -1,0 +1,44 @@
+"""Known-good fixture for JX014: the AOT discipline — compile only
+bucket-table shapes up front, guard the lazy seam with the frozen
+check, pad requests through bucket_for()."""
+
+import jax
+import numpy as np
+
+
+class BucketedEngine:
+    def __init__(self, forward, buckets, image_size):
+        self._fwd = forward
+        self.image_size = int(image_size)
+        self.buckets = tuple(sorted(buckets))
+        self._compiled = {}
+        self._frozen = False
+        for b in self.buckets:
+            self._compile(b)
+
+    def _compile(self, bucket):
+        if self._frozen:
+            raise RuntimeError(
+                f"bucket {bucket} has no AOT executable and the engine is warm"
+            )
+        shape = jax.ShapeDtypeStruct(
+            (bucket, self.image_size, self.image_size, 3), "uint8"
+        )
+        compiled = jax.jit(self._fwd).lower(shape).compile()
+        self._compiled[bucket] = compiled
+        return compiled
+
+    def freeze(self):
+        self._frozen = True
+
+    def bucket_for(self, n):
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"batch of {n} exceeds {self.buckets[-1]}")
+
+    def run(self, images):
+        bucket = self.bucket_for(images.shape[0])
+        padded = np.zeros((bucket,) + images.shape[1:], images.dtype)
+        padded[: images.shape[0]] = images
+        return self._compiled[bucket](padded)

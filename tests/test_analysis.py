@@ -25,7 +25,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures", "lint")
 ALL_RULES = (
     "JX001", "JX002", "JX003", "JX004", "JX005", "JX006", "JX007",
-    "JX008", "JX009", "JX010", "JX011",
+    "JX008", "JX009", "JX010", "JX011", "JX012", "JX013", "JX014",
 )
 
 _EXPECT_RE = re.compile(r"#\s*expect:\s*([A-Z0-9,\s]+)")

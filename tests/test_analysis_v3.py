@@ -1,0 +1,471 @@
+"""mocolint v3: the thread-escape + lock-set model (analysis/threads.py),
+the concurrency rules JX012/JX013 and the AOT freeze rule JX014, the
+`--changed` fast pre-pass, and the runtime lock-order sanitizer
+(analysis/tsan.py) with its `deadlock@site` chaos hook."""
+
+import json
+import os
+import queue
+import subprocess
+import threading
+
+import pytest
+
+from moco_tpu.analysis import analyze_source, tsan
+from moco_tpu.analysis.__main__ import main as mocolint_main
+from moco_tpu.analysis.engine import Finding, parse_module
+from moco_tpu.analysis.threads import component_models
+from moco_tpu.utils import faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _model(src: str, cls: str = None):
+    ctx = parse_module(src, "m.py")
+    assert not isinstance(ctx, Finding)
+    models = component_models(ctx)
+    if cls is None:
+        assert len(models) == 1
+        return models[0]
+    return next(m for m in models if m.name == cls)
+
+
+# ---------------------------------------------------------------------------
+# thread-escape model
+
+
+def test_thread_target_and_public_roots():
+    m = _model(
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._t = threading.Thread(target=self._run)\n"
+        "    def _run(self):\n"
+        "        self.x = 1\n"
+        "    def poke(self):\n"
+        "        self.x = 2\n"
+    )
+    assert m.roots["_run"] == {"thread:_run"}
+    assert "main" in m.roots["poke"]
+
+
+def test_http_handler_methods_are_many_threaded_roots():
+    m = _model(
+        "import http.server\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        server = self\n"
+        "        class Handler(http.server.BaseHTTPRequestHandler):\n"
+        "            def do_GET(self):\n"
+        "                server.hits += 1\n"
+    )
+    assert m.roots["Handler.do_GET"] == {"http:do_GET"}
+    assert m.thread_weight("http:do_GET") == 2  # one thread per request
+    shared = list(m.shared_attr_accesses())
+    assert [attr for attr, _, _ in shared] == ["hits"]
+
+
+def test_callback_escape_is_a_root_but_property_is_not():
+    m = _model(
+        "class C:\n"
+        "    def __init__(self, batcher, fmt):\n"
+        "        batcher(self._on_done)\n"
+        "        fmt(self.avg)\n"
+        "    def _on_done(self):\n"
+        "        self.n += 1\n"
+        "    @property\n"
+        "    def avg(self):\n"
+        "        self.n += 1\n"
+        "        return self.n\n"
+    )
+    assert m.roots["_on_done"] == {"callback:_on_done"}
+    # the property is a public READ (main root) but NOT a callback escape
+    assert "callback:avg" not in m.roots["avg"]
+
+
+def test_alias_resolves_to_component_and_nested_self_calls():
+    m = _model(
+        "import threading\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        server = self\n"
+        "        class Handler:\n"
+        "            def do_POST(self):\n"
+        "                self._helper()\n"
+        "            def _helper(self):\n"
+        "                with server._lock:\n"
+        "                    server.rows += 1\n"
+    )
+    # do_POST -> Handler._helper resolved; the helper's write is rooted
+    # and carries the alias-resolved lock
+    writes = [a for a in m.accesses if a.attr == "rows" and a.is_write]
+    assert writes and writes[0].locks == frozenset({"self._lock"})
+    assert m.roots["Handler._helper"] == {"http:do_POST"}
+
+
+def test_inherited_lock_through_private_helper():
+    m = _model(
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def flush(self):\n"
+        "        with self._lock:\n"
+        "            self._write()\n"
+        "    def _write(self):\n"
+        "        self.n += 1\n"
+    )
+    writes = [a for a in m.accesses if a.attr == "n" and a.is_write]
+    assert writes[0].locks == frozenset({"self._lock"})
+
+
+def test_safe_typed_attrs_are_exempt():
+    m = _model(
+        "import queue, threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._q = queue.Queue()\n"
+        "        self._stop = threading.Event()\n"
+        "        self._t = threading.Thread(target=self._run)\n"
+        "    def _run(self):\n"
+        "        self._q.put(1)\n"
+        "    def close(self):\n"
+        "        self._q.put(None)\n"
+        "        self._stop.set()\n"
+    )
+    assert list(m.shared_attr_accesses()) == []
+
+
+# ---------------------------------------------------------------------------
+# JX012 semantics on inline snippets
+
+
+def test_jx012_common_lock_is_clean():
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._t = threading.Thread(target=self._run)\n"
+        "        self._t.start()\n"
+        "    def _run(self):\n"
+        "        with self._lock:\n"
+        "            self.n = 1\n"
+        "    def read(self):\n"
+        "        with self._lock:\n"
+        "            return self.n\n"
+        "    def close(self):\n"
+        "        self._t.join()\n"
+    )
+    assert analyze_source(src, "m.py", rules=["JX012"]) == []
+
+
+def test_jx012_flags_unlocked_read_of_guarded_attr():
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._t = threading.Thread(target=self._run)\n"
+        "        self._t.start()\n"
+        "    def _run(self):\n"
+        "        with self._lock:\n"
+        "            self.n = 1\n"
+        "    def read(self):\n"
+        "        return self.n\n"
+        "    def close(self):\n"
+        "        self._t.join()\n"
+    )
+    findings = analyze_source(src, "m.py", rules=["JX012"])
+    assert len(findings) == 1 and "without lock 'self._lock'" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# JX013 semantics
+
+
+def test_jx013_consistent_order_is_clean():
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._a_lock = threading.Lock()\n"
+        "        self._b_lock = threading.Lock()\n"
+        "    def one(self):\n"
+        "        with self._a_lock:\n"
+        "            with self._b_lock:\n"
+        "                pass\n"
+        "    def two(self):\n"
+        "        with self._a_lock:\n"
+        "            with self._b_lock:\n"
+        "                pass\n"
+    )
+    assert analyze_source(src, "m.py", rules=["JX013"]) == []
+
+
+def test_jx013_cycle_through_inherited_lock():
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._a_lock = threading.Lock()\n"
+        "        self._b_lock = threading.Lock()\n"
+        "    def one(self):\n"
+        "        with self._a_lock:\n"
+        "            self._inner()\n"
+        "    def _inner(self):\n"
+        "        with self._b_lock:\n"
+        "            pass\n"
+        "    def two(self):\n"
+        "        with self._b_lock:\n"
+        "            with self._a_lock:\n"
+        "                pass\n"
+    )
+    findings = analyze_source(src, "m.py", rules=["JX013"])
+    assert len(findings) == 1 and "lock-order cycle" in findings[0].message
+
+
+def test_jx013_blocking_sleep_under_lock():
+    src = (
+        "import threading, time\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def slow(self):\n"
+        "        with self._lock:\n"
+        "            time.sleep(5)\n"
+    )
+    findings = analyze_source(src, "m.py", rules=["JX013"])
+    assert len(findings) == 1 and "time.sleep" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# JX014 semantics
+
+
+def test_jx014_guarded_seam_is_clean():
+    src = (
+        "import jax\n"
+        "class E:\n"
+        "    def freeze(self):\n"
+        "        self._frozen = True\n"
+        "    def _compile(self, bucket):\n"
+        "        if self._frozen:\n"
+        "            raise RuntimeError(bucket)\n"
+        "        return jax.jit(self._f).lower(bucket).compile()\n"
+        "    def run(self, images):\n"
+        "        return self._compile(images.shape[0])\n"
+    )
+    assert analyze_source(src, "m.py", rules=["JX014"]) == []
+
+
+def test_jx014_raw_shape_to_unguarded_seam():
+    src = (
+        "import jax\n"
+        "class E:\n"
+        "    def freeze(self):\n"
+        "        self._frozen = True\n"
+        "    def _compile(self, bucket):\n"
+        "        return jax.jit(self._f).lower(bucket).compile()\n"
+        "    def run(self, images):\n"
+        "        return self._compile(images.shape[0])\n"
+    )
+    findings = analyze_source(src, "m.py", rules=["JX014"])
+    assert len(findings) == 1 and "compile seam" in findings[0].message
+
+
+def test_jx014_bucket_for_sanitizes():
+    src = (
+        "import jax\n"
+        "class E:\n"
+        "    def freeze(self):\n"
+        "        self._frozen = True\n"
+        "    def bucket_for(self, n):\n"
+        "        return min(b for b in self.buckets if n <= b)\n"
+        "    def _compile(self, bucket):\n"
+        "        return jax.jit(self._f).lower(bucket).compile()\n"
+        "    def run(self, images):\n"
+        "        return self._compile(self.bucket_for(images.shape[0]))\n"
+    )
+    assert analyze_source(src, "m.py", rules=["JX014"]) == []
+
+
+# ---------------------------------------------------------------------------
+# --changed mode
+
+
+def test_changed_mode_lints_only_the_diff(tmp_path, capsys):
+    repo = tmp_path / "r"
+    repo.mkdir()
+
+    def git(*args):
+        subprocess.run(
+            ["git", "-c", "user.email=t@t", "-c", "user.name=t", *args],
+            cwd=repo, check=True, capture_output=True,
+        )
+
+    git("init", "-q")
+    clean = repo / "clean.py"
+    clean.write_text("import time\n\n\ndef ok():\n    return time.time()\n")
+    git("add", "-A")
+    git("commit", "-qm", "base")
+    bad = repo / "bad.py"
+    bad.write_text(
+        "import time\nimport jax\n\n\n@jax.jit\ndef f(x):\n    return x + time.time()\n"
+    )
+    git("add", "-A")
+    git("commit", "-qm", "bad")
+    cwd = os.getcwd()
+    os.chdir(repo)
+    try:
+        # vs HEAD~1 only bad.py is linted -> findings -> exit 1
+        assert mocolint_main([".", "--no-baseline", "--changed", "HEAD~1"]) == 1
+        out = capsys.readouterr().out
+        assert "linting 1 file(s)" in out and "bad.py" in out
+        # vs HEAD nothing changed -> exit 0 without analyzing
+        assert mocolint_main([".", "--no-baseline", "--changed", "HEAD"]) == 0
+        assert "no python files changed" in capsys.readouterr().out
+    finally:
+        os.chdir(cwd)
+
+
+# ---------------------------------------------------------------------------
+# runtime arm: tsan
+
+
+@pytest.fixture
+def clean_tsan():
+    prev = tsan.install_recorder(None)
+    yield
+    tsan.install_recorder(prev)
+    faults.clear()
+
+
+def test_traced_lock_is_plain_without_recorder(clean_tsan):
+    lk = tsan.make_lock("x")
+    with lk:
+        assert lk.locked()
+    assert not lk.locked()
+
+
+def test_ab_ba_cycle_raises_with_artifact(tmp_path, clean_tsan):
+    san = tsan.ThreadSanitizer(workdir=str(tmp_path), strict=True, profile=False)
+    try:
+        a, b = tsan.make_lock("a"), tsan.make_lock("b")
+
+        def ab():
+            with a:
+                with b:
+                    pass
+
+        t = threading.Thread(target=ab)
+        t.start()
+        t.join()
+        with pytest.raises(tsan.LockOrderError):
+            with b:
+                with a:  # the inverted order: caught BEFORE blocking
+                    pass
+    finally:
+        rep = san.close()
+    assert rep["cycles"], rep
+    diff = json.loads((tmp_path / "lock_order_diff.json").read_text())
+    assert diff["cycle"][0] == diff["cycle"][-1]
+    # both directions present, each with a recorded stack
+    dirs = {(e["held"], e["acquired"]) for e in diff["edges"]}
+    assert dirs == {("a", "b"), ("b", "a")}
+    assert all(e["stack"] for e in diff["edges"])
+
+
+def test_deadlock_fault_forces_inverted_edge(tmp_path, clean_tsan):
+    faults.install("deadlock@site=inner")
+    san = tsan.ThreadSanitizer(workdir=str(tmp_path), strict=False, profile=False)
+    try:
+        outer, inner = tsan.make_lock("outer"), tsan.make_lock("inner")
+        with outer:
+            with inner:  # the ONLY nesting — the fault synthesizes BA
+                pass
+    finally:
+        rep = san.close()
+    assert len(rep["cycles"]) == 1
+    injected = [e for e in rep["edges"] if e["injected"]]
+    assert injected == [{"held": "inner", "acquired": "outer", "injected": True}]
+    assert (tmp_path / "lock_order_diff.json").exists()
+
+
+def test_sanitizer_check_raises_on_recorded_cycle(tmp_path, clean_tsan):
+    faults.install("deadlock@site=i2")
+    san = tsan.ThreadSanitizer(workdir=str(tmp_path), strict=False, profile=False)
+    o, i = tsan.make_lock("o2"), tsan.make_lock("i2")
+    with o:
+        with i:
+            pass
+    with pytest.raises(tsan.LockOrderError):
+        san.check()
+    san.close()
+
+
+def test_profile_hook_records_blocking_ops_under_lock(clean_tsan):
+    san = tsan.ThreadSanitizer(workdir=None, strict=True, profile=True)
+    try:
+        lk = tsan.make_lock("held")
+        q = queue.Queue()
+        q.put("primed")
+        with lk:
+            q.put(1)          # unbounded put: recorded
+            q.get()           # blocking get: recorded
+        q.get(timeout=1.0)    # bounded AND no lock held: not recorded
+    finally:
+        rep = san.close()
+    ops = [b["op"] for b in rep["blocking_ops_under_lock"]]
+    assert any("put" in o for o in ops) and any("get" in o for o in ops)
+    assert all(b["held"] == ["held"] for b in rep["blocking_ops_under_lock"])
+
+
+def test_rlock_reentry_does_not_self_edge(clean_tsan):
+    san = tsan.ThreadSanitizer(workdir=None, strict=True, profile=False)
+    try:
+        r = tsan.make_rlock("r")
+        with r:
+            with r:  # re-entry: no r->r edge, no cycle
+                pass
+    finally:
+        rep = san.close()
+    assert rep["edges"] == [] and rep["cycles"] == []
+
+
+# ---------------------------------------------------------------------------
+# the serve-shaped smoke leg (slow): real batcher + metrics under the
+# sanitizer — a clean pass with genuine lock traffic
+
+
+@pytest.mark.slow
+def test_batcher_clean_under_sanitize_threads(clean_tsan):
+    import numpy as np
+
+    from moco_tpu.serve.batcher import ContinuousBatcher, ServeMetrics
+
+    san = tsan.ThreadSanitizer(workdir=None, strict=True, profile=True)
+    try:
+        metrics = ServeMetrics(slo_ms=1000.0)
+        index_lock = tsan.make_lock("serve.index")
+
+        def run_batch(images, want_neighbors):
+            with index_lock:  # the server's sanctioned nesting shape
+                payload = metrics.payload()
+            assert payload["serve/slo_ms"] == 1000.0
+            return {"embedding": np.zeros((images.shape[0], 4), np.float32)}, [
+                (images.shape[0], images.shape[0])
+            ]
+
+        batcher = ContinuousBatcher(run_batch, max_batch=8, slo_ms=50.0, metrics=metrics)
+        futs = [batcher.submit(np.zeros((2, 4, 4, 3), np.uint8)) for _ in range(6)]
+        for f in futs:
+            f.result(timeout=10.0)
+        batcher.close()
+    finally:
+        rep = san.close()
+    assert rep["cycles"] == []
+    assert rep["acquisitions"] > 0
+    edges = {(e["held"], e["acquired"]) for e in rep["edges"]}
+    assert ("serve.index", "serve.metrics") in edges

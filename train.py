@@ -172,6 +172,15 @@ def build_parser() -> argparse.ArgumentParser:
         "collective",
     )
     p.add_argument(
+        "--sanitize-threads", action="store_true", default=None,
+        help="mocolint v3 runtime arm: trace every tsan-factory lock's "
+        "acquisition order per thread, abort with both stacks "
+        "(lock_order_diff.json) the moment two paths disagree on the "
+        "nesting — BEFORE the deadlock wedges the process; blocking ops "
+        "under a held lock land in the run report (lock_order.json). "
+        "Smoke-run tooling: the profile hook costs real CPU",
+    )
+    p.add_argument(
         "--elastic", action="store_true", default=None,
         help="elastic training (parallel/elastic.py): on heartbeat loss "
         "the survivors agree on the event, take an emergency checkpoint, "
@@ -380,6 +389,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         strict_tracing=args.strict_tracing,
         recompile_warmup_steps=args.recompile_warmup,
         sanitize_collectives=args.sanitize_collectives,
+        sanitize_threads=args.sanitize_threads,
         sinks=args.sinks,
         metrics_port=args.metrics_port,
         metrics_host=args.metrics_host,
