@@ -213,6 +213,11 @@ FIELD_VALIDATORS = {
     # fleet observability (obs/fleet.py; process-0 lines only)
     "fleet_hosts": _int_like,
     "straggler_skew": _num_or_null,
+    # serving-fleet router gauges (serve/router.py FleetRouter.stats):
+    # topology counts are ints; the objective mirrors serve/slo_objective
+    "fleet_serve/replicas": lambda v: _int_like(v) and v >= 1,
+    "fleet_serve/replicas_healthy": lambda v: _int_like(v) and v >= 0,
+    "fleet_serve/slo_objective": lambda v: _num(v) and 0.0 < v < 1.0,
     # alert event lines (obs/alerts.py)
     "alert": lambda v: isinstance(v, str),
     "severity": lambda v: v in ("warn", "fatal"),
@@ -241,6 +246,12 @@ PREFIX_VALIDATORS = {
     # matching prefix wins (see validate_line), so these shadow serve/.
     "serve/trace_": _nonneg_or_null,
     "serve/burn_rate_": _nonneg_or_null,
+    # the fleet-router family (serve/router.py): latency gauges null
+    # before the first proxied request, counters numeric; the burn
+    # sub-family (router client-observed + per-replica min/mean/max
+    # aggregates) is never negative, like its serve/ twin
+    "fleet_serve/": _num_or_null,
+    "fleet_serve/burn_rate_": _nonneg_or_null,
 }
 
 

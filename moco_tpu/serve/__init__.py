@@ -28,6 +28,13 @@ path (see each module's docstring):
 - `server`  stdlib HTTP endpoint (`/embed`, `/neighbors`, `/stats`,
             `/healthz`) feeding the `serve/*` metric family into the
             obs sinks (JSONL schema + Prometheus gauges)
+- `router`  the fleet front door: health/load-aware dispatch over N
+            replicas with per-replica circuit breakers, bounded retry,
+            p99-hedging, load shedding, and graceful drain/restart —
+            exports the `fleet_serve/*` gauge family
+- `fleet`   ReplicaSupervisor: spawns/watches `replica_main` replica
+            processes, auto-restarts crashes with backoff, re-warms a
+            reborn replica's index via `/ingest`
 
 Everything resolves lazily so `import moco_tpu.serve` stays cheap and
 jax-free until a component is actually built.
@@ -58,6 +65,13 @@ _LAZY = {
     "DEFAULT_LATENCY_BUCKETS_MS": "batcher",
     "ServeServer": "server",
     "resolve_serve_port": "server",
+    "CircuitBreaker": "router",
+    "FleetRouter": "router",
+    "ReplicaAttemptError": "router",
+    "ReplicaUnavailableError": "router",
+    "RouterMetrics": "router",
+    "ReplicaSupervisor": "fleet",
+    "default_replica_argv": "fleet",
 }
 
 

@@ -18,6 +18,14 @@ the queue, fails all pending futures with :class:`BatcherClosedError`
 (so put-blocked producers and result-blocked clients both unblock), and
 joins the batcher thread.
 
+Shutdown comes in two flavors. `close()` is the abort path: anything
+still pending fails fast with BatcherClosedError. `drain()` is the
+graceful one — intake shuts (new submits raise), but every rider
+already accepted is FLUSHED, not failed, and only then does the thread
+stop. The server's SIGTERM path and `/admin/drain` ride drain(), which
+is what makes a fleet-router drain/restart a zero-dropped-requests
+operation rather than a burst of 503s.
+
 Metrics (`ServeMetrics`): per-request latency reservoir → p50/p99,
 completed-request QPS, batch occupancy (valid rows / padded bucket
 rows — the padding tax), a per-bucket execution histogram, per-tier
@@ -341,6 +349,11 @@ class ContinuousBatcher:
         self.metrics = metrics or ServeMetrics(slo_ms)
         self._q: queue.Queue = queue.Queue(maxsize=queue_depth)
         self._stop = threading.Event()
+        # graceful-drain pair: _draining gates intake (submit raises,
+        # accepted work still flushes), _drained flips when the loop has
+        # flushed everything and exited — drain() waits on it
+        self._draining = threading.Event()
+        self._drained = threading.Event()
         self._thread = threading.Thread(
             target=self._loop, name="serve_batcher", daemon=True
         )
@@ -369,6 +382,8 @@ class ContinuousBatcher:
         fut = ServeFuture(
             images.shape[0], time.perf_counter(), want_neighbors, mode, trace
         )
+        if self._draining.is_set():
+            raise BatcherClosedError("batcher is draining")
         if self._stop.is_set() or not _responsive_put(self._q, self._stop, (images, fut)):
             raise BatcherClosedError("batcher is closed")
         return fut
@@ -455,18 +470,29 @@ class ContinuousBatcher:
         pending: list = []
         rows = 0
         while not self._stop.is_set():
+            draining = self._draining.is_set()
             if pending:
                 timeout = self.deadline_s - (
                     time.perf_counter() - pending[0][1].submitted_at
                 )
-                if timeout <= 0 or rows >= self.max_batch:
+                # draining with an empty queue: intake is shut, nobody
+                # else is coming — flush now instead of idling out the
+                # coalescing deadline on riders already in hand
+                if timeout <= 0 or rows >= self.max_batch or (
+                    draining and self._q.empty()
+                ):
                     self._flush(pending)
                     pending, rows = [], 0
                     continue
+            elif draining and self._q.empty():
+                break  # graceful exit: everything accepted was flushed
             else:
                 timeout = 0.05  # idle poll so close() never waits long
             try:
-                item = self._q.get(timeout=timeout)
+                # the get poll is capped so a drain()/close() raised while
+                # the thread is blocked here is noticed within 50ms, not
+                # after the full coalescing deadline
+                item = self._q.get(timeout=min(timeout, 0.05))
             except queue.Empty:
                 continue
             images, fut = item
@@ -485,6 +511,18 @@ class ContinuousBatcher:
             except queue.Empty:
                 break
             fut._fail(BatcherClosedError("batcher closed with request queued"))
+        self._drained.set()
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Graceful shutdown: stop intake (new submits raise
+        BatcherClosedError), flush every already-accepted rider, then
+        close. Returns True when the flush finished inside `timeout`
+        (False = close() fell back to failing the stragglers). Safe
+        from any thread, idempotent, and close()-compatible."""
+        self._draining.set()
+        drained = self._drained.wait(timeout)
+        self.close()
+        return drained
 
     def close(self, timeout: float = 10.0) -> None:
         """Stop coalescing, fail all pending/queued futures, join the

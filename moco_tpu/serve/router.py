@@ -1,0 +1,888 @@
+"""Serving fleet front door: a fault-tolerant HTTP router over N
+replicas (`ServeServer` processes — usually spawned by
+`serve/fleet.py`'s ReplicaSupervisor).
+
+One replica is one SIGKILL away from a total outage; the router is the
+robustness layer the ROADMAP's "Serving fleet" item asks for. Same
+stdlib idiom as `serve/server.py` (ThreadingHTTPServer, tsan-traced
+locks, a metrics flusher on the obs sinks), plus the four classic
+front-door behaviors:
+
+- **Health/load-aware dispatch** — a poller thread reads each replica's
+  `/healthz` + `/stats`; requests go to the admitted (healthy, not
+  draining) replica with the fewest in-flight dispatches.
+- **Per-replica circuit breakers** — `fail_threshold` consecutive
+  transport/5xx failures trip a replica OPEN; after an (exponentially
+  growing) cooldown exactly ONE half-open probe request is admitted,
+  and its outcome closes or re-trips the breaker. A dead replica costs
+  one connection-refused per cooldown, not one per request.
+- **Bounded retry + hedging** — `/embed` and `/neighbors` are
+  idempotent, so a failed dispatch re-routes through `utils/retry.py`
+  (sites `router.embed` / `router.neighbors`, counted in the io_retries
+  ledger), and a request that outlives the p99-derived hedge delay is
+  duplicated to a second replica, first success wins (`hedges` /
+  `hedge_wins` counters; the losing attempt is discarded on arrival —
+  stdlib urlopen cannot be aborted mid-flight).
+- **Load shedding + graceful drain** — past `max_inflight` concurrent
+  requests the router answers 503 with a `Retry-After` header (counted,
+  never a silent drop). `POST /admin/drain?replica=i` stops new
+  dispatch to i, waits out its in-flight requests, restarts it through
+  the supervisor (SIGTERM → the replica's batcher drain → respawn →
+  warm re-ingest), and re-admits it on healthy — zero dropped requests.
+
+Endpoints: `POST /embed`, `POST /neighbors` (proxied; the response
+gains a `"replica": i` field next to the replica-scoped `request_id`,
+so a flight-recorder dump blames the right process), `GET /healthz`,
+`GET /stats` (the `fleet_serve/*` gauge line), `GET /admin/replicas`
+(fleet topology — `scripts/serve_ingest.py --fanout` discovers the
+replica URLs here), `POST /admin/drain?replica=i[&restart=0]`,
+`POST /admin/undrain?replica=i`.
+
+Observability rides the PR 10 rails: the router's own client-observed
+`SLOBurnTracker` exports `fleet_serve/burn_rate_<w>s` (the chaos leg's
+acceptance gauge), and each replica's `serve/burn_rate_<w>s` gauges are
+aggregated min/mean/max (the `obs/fleet.py` pattern) alongside
+`fleet_serve/replicas_healthy`, per-replica dispatch counts, and the
+hedge/retry/shed/breaker counters.
+
+Threading (JX011/JX012/JX013 discipline): ONE fleet lock
+(`router.fleet`, tsan factory) guards every replica handle and breaker
+— no per-replica locks, so there is no order to invert — and one
+metrics lock (`router.metrics`) inside RouterMetrics; the two are never
+nested. All network I/O happens strictly outside both locks. The
+health poller, the metrics flusher, and the single drain worker are
+joined in `close()`.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import http.server
+import json
+import queue
+import threading
+import time
+import urllib.error
+import urllib.request
+from collections import Counter, deque
+from typing import Optional
+
+from moco_tpu.analysis import tsan
+from moco_tpu.obs.slo import DEFAULT_WINDOWS, SLOBurnTracker
+from moco_tpu.utils import retry as retry_mod
+
+BREAKER_CLOSED = "closed"
+BREAKER_OPEN = "open"
+BREAKER_HALF_OPEN = "half_open"
+
+
+class ReplicaAttemptError(OSError):
+    """One dispatch attempt failed (transport error, timeout, or a 5xx
+    from the replica). An OSError so the `utils/retry.py` default
+    `retry_on` covers it — the request is idempotent, re-route it."""
+
+
+class ReplicaUnavailableError(OSError):
+    """No admitted replica could take (or answer) the request this
+    round. Also an OSError: the retry layer backs off and re-polls the
+    fleet, because a replica may be seconds from rejoining."""
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker with half-open probe recovery.
+
+    NOT internally locked: the router serializes every call under its
+    fleet lock (one lock for all fleet state — no order to invert).
+    `try_acquire()` both asks AND claims: in OPEN past the cooldown it
+    transitions to HALF_OPEN and hands the caller the single probe
+    slot, so two racing dispatchers cannot double-probe. Cooldown grows
+    exponentially with consecutive trips (capped) and resets on any
+    recovery. `now` is injectable for tests.
+    """
+
+    def __init__(
+        self,
+        fail_threshold: int = 3,
+        cooldown_s: float = 2.0,
+        cooldown_cap_s: float = 30.0,
+        now=time.monotonic,
+    ):
+        self.fail_threshold = int(fail_threshold)
+        self.cooldown_s = float(cooldown_s)
+        self.cooldown_cap_s = float(cooldown_cap_s)
+        self._now = now
+        self.state = BREAKER_CLOSED
+        self.consecutive_failures = 0
+        self.trips = 0  # lifetime trip count (fleet_serve/breaker_trips)
+        self._trip_streak = 0  # trips since the last recovery → backoff
+        self._open_until = 0.0
+        self._probe_inflight = False
+
+    def try_acquire(self) -> bool:
+        """May the caller dispatch to this replica right now? Claims
+        the half-open probe slot when it says yes from OPEN."""
+        if self.state == BREAKER_CLOSED:
+            return True
+        if self.state == BREAKER_OPEN:
+            if self._now() >= self._open_until:
+                self.state = BREAKER_HALF_OPEN
+                self._probe_inflight = True
+                return True
+            return False
+        # HALF_OPEN: exactly one probe at a time
+        if not self._probe_inflight:
+            self._probe_inflight = True
+            return True
+        return False
+
+    def record_success(self) -> None:
+        if self.state == BREAKER_OPEN:
+            # a straggler from before the trip; recovery goes through
+            # the half-open probe, not a stale success
+            return
+        self.state = BREAKER_CLOSED
+        self.consecutive_failures = 0
+        self._trip_streak = 0
+        self._probe_inflight = False
+
+    def record_failure(self) -> None:
+        self.consecutive_failures += 1
+        if self.state == BREAKER_HALF_OPEN:
+            self._probe_inflight = False
+            self._trip()
+        elif (
+            self.state == BREAKER_CLOSED
+            and self.consecutive_failures >= self.fail_threshold
+        ):
+            self._trip()
+
+    def reset(self) -> None:
+        """Back to pristine CLOSED — the router calls this when a
+        drained replica is re-admitted after a supervised restart."""
+        self.state = BREAKER_CLOSED
+        self.consecutive_failures = 0
+        self._trip_streak = 0
+        self._probe_inflight = False
+
+    def _trip(self) -> None:
+        self.state = BREAKER_OPEN
+        self.trips += 1
+        self._trip_streak += 1
+        cooldown = min(
+            self.cooldown_cap_s, self.cooldown_s * (2 ** (self._trip_streak - 1))
+        )
+        self._open_until = self._now() + cooldown
+
+
+class ReplicaHandle:
+    """Router-side state for one replica. Every field is read and
+    written ONLY under the router's fleet lock."""
+
+    def __init__(self, index: int, url: str, breaker: CircuitBreaker):
+        self.index = int(index)
+        self.url = url.rstrip("/")
+        self.breaker = breaker
+        self.healthy = False
+        self.warm = False
+        self.draining = False
+        self.drain_phase: Optional[str] = None
+        self.inflight = 0
+        self.dispatched = 0
+        self.stats: dict = {}  # last /stats payload the poller saw
+
+    @property
+    def admitted(self) -> bool:
+        return self.healthy and not self.draining
+
+    def snapshot(self) -> dict:
+        return {
+            "index": self.index,
+            "url": self.url,
+            "healthy": self.healthy,
+            "warm": self.warm,
+            "draining": self.draining,
+            "drain_phase": self.drain_phase,
+            "breaker": self.breaker.state,
+            "breaker_trips": self.breaker.trips,
+            "inflight": self.inflight,
+            "dispatched": self.dispatched,
+        }
+
+
+class RouterMetrics:
+    """Thread-safe router gauges; `payload()` is the `fleet_serve/*`
+    core (the router's OWN client-observed latency/burn — the
+    per-replica aggregation joins in FleetRouter.stats())."""
+
+    def __init__(
+        self,
+        slo_ms: float,
+        objective: float = 0.99,
+        windows=DEFAULT_WINDOWS,
+        window: int = 2048,
+    ):
+        self.slo_ms = float(slo_ms)
+        self._lock = tsan.make_lock("router.metrics")
+        self.burn = SLOBurnTracker(slo_ms, objective=objective, windows=windows)
+        self._latencies_ms: deque = deque(maxlen=window)
+        self._counters: Counter = Counter()
+        self._completed = 0
+        self._win_completed = 0
+        self._win_t0 = time.perf_counter()
+
+    def count(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] += n
+
+    def record_request(self, latency_s: float, ok: bool) -> None:
+        ms = latency_s * 1e3
+        with self._lock:
+            self._latencies_ms.append(ms)
+            self._completed += 1
+            self._win_completed += 1
+        self.burn.record(ok and ms <= self.slo_ms)
+
+    def record_failure(self) -> None:
+        """A request the fleet failed (retries exhausted) or shed —
+        burns error budget; never a silent drop."""
+        self.burn.record(False)
+
+    def p99_ms(self) -> Optional[float]:
+        with self._lock:
+            lat = sorted(self._latencies_ms)
+        if not lat:
+            return None
+        return lat[min(int(0.99 * (len(lat) - 1) + 0.5), len(lat) - 1)]
+
+    def payload(self) -> dict:
+        with self._lock:
+            now = time.perf_counter()
+            dt = max(now - self._win_t0, 1e-9)
+            qps = self._win_completed / dt
+            self._win_t0, self._win_completed = now, 0
+            lat = sorted(self._latencies_ms)
+            pct = lambda p: (
+                lat[min(int(p * (len(lat) - 1) + 0.5), len(lat) - 1)] if lat else None
+            )
+            counters = dict(self._counters)
+            completed = self._completed
+            out = {
+                "fleet_serve/requests": completed,
+                "fleet_serve/qps": qps,
+                "fleet_serve/p50_ms": pct(0.50),
+                "fleet_serve/p99_ms": pct(0.99),
+                "fleet_serve/slo_ms": self.slo_ms,
+            }
+        for name in ("hedges", "hedge_wins", "shed", "failed", "drains"):
+            out[f"fleet_serve/{name}"] = counters.get(name, 0)
+        # the burn family under the fleet prefix: the ROUTER's own
+        # client-observed burn — the chaos leg's acceptance gauge
+        for k, v in self.burn.payload().items():
+            out["fleet_serve/" + k.split("/", 1)[1]] = v
+        return out
+
+
+class FleetRouter:
+    """The fleet front door (module docstring). `replica_urls` lists
+    the replica base URLs; alternatively pass a started
+    `ReplicaSupervisor` and the URLs are taken from it (and drain can
+    restart replicas). `port=0` binds ephemeral; `self.port` is real.
+    """
+
+    def __init__(
+        self,
+        replica_urls=None,
+        supervisor=None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        slo_ms: float = 1000.0,
+        slo_objective: float = 0.99,
+        burn_windows=DEFAULT_WINDOWS,
+        sink=None,
+        metrics_flush_s: float = 1.0,
+        health_interval_s: float = 0.5,
+        health_timeout_s: float = 2.0,
+        replica_timeout_s: float = 30.0,
+        retry_attempts: int = 3,
+        retry_base_delay_s: float = 0.05,
+        retry_max_delay_s: float = 1.0,
+        hedge: bool = True,
+        hedge_min_ms: float = 250.0,
+        hedge_p99_factor: float = 1.0,
+        max_inflight: int = 64,
+        shed_retry_after_s: float = 1.0,
+        breaker_fail_threshold: int = 3,
+        breaker_cooldown_s: float = 2.0,
+        breaker_cooldown_cap_s: float = 30.0,
+        drain_timeout_s: float = 60.0,
+        readmit_timeout_s: float = 300.0,
+    ):
+        if replica_urls is None:
+            if supervisor is None:
+                raise ValueError("need replica_urls or a supervisor")
+            replica_urls = supervisor.urls()
+        if not replica_urls:
+            raise ValueError("a fleet needs at least one replica")
+        self._supervisor = supervisor
+        self.health_interval_s = float(health_interval_s)
+        self.health_timeout_s = float(health_timeout_s)
+        self.replica_timeout_s = float(replica_timeout_s)
+        self.retry_attempts = int(retry_attempts)
+        self.retry_base_delay_s = float(retry_base_delay_s)
+        self.retry_max_delay_s = float(retry_max_delay_s)
+        self.hedge = bool(hedge)
+        self.hedge_min_ms = float(hedge_min_ms)
+        self.hedge_p99_factor = float(hedge_p99_factor)
+        self.max_inflight = int(max_inflight)
+        self.shed_retry_after_s = float(shed_retry_after_s)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.readmit_timeout_s = float(readmit_timeout_s)
+        self.metrics = RouterMetrics(
+            slo_ms, objective=slo_objective, windows=burn_windows
+        )
+        self._sink = sink
+        # ONE lock for all fleet state (handles + breakers + the
+        # admission counter): no per-replica locks, no order to invert
+        self._fleet_lock = tsan.make_lock("router.fleet")
+        self._replicas = [
+            ReplicaHandle(
+                i,
+                url,
+                CircuitBreaker(
+                    fail_threshold=breaker_fail_threshold,
+                    cooldown_s=breaker_cooldown_s,
+                    cooldown_cap_s=breaker_cooldown_cap_s,
+                ),
+            )
+            for i, url in enumerate(replica_urls)
+        ]
+        self._active = 0  # router-wide in-flight count (shed budget)
+        # dispatch pool: primary + hedge attempts run here so the
+        # handler thread can time out the primary without abandoning it
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=2 * self.max_inflight + 4,
+            thread_name_prefix="router_dispatch",
+        )
+        self._stop = threading.Event()
+        self._drain_q: queue.Queue = queue.Queue()
+        # one synchronous poll before serving: dispatch works from the
+        # first request instead of waiting out a poller interval
+        self._poll_health()
+        server = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                path = self.path.split("?")[0]
+                if path == "/healthz":
+                    with server._fleet_lock:
+                        healthy = sum(1 for r in server._replicas if r.admitted)
+                        total = len(server._replicas)
+                    self._json(200, {
+                        "ok": healthy > 0,
+                        "replicas": total,
+                        "replicas_healthy": healthy,
+                    })
+                elif path == "/stats":
+                    self._json(200, server.stats())
+                elif path == "/admin/replicas":
+                    with server._fleet_lock:
+                        snaps = [r.snapshot() for r in server._replicas]
+                    self._json(200, {"replicas": snaps})
+                else:
+                    self.send_error(404)
+
+            def do_POST(self):  # noqa: N802
+                t0 = time.perf_counter()
+                path, _, query = self.path.partition("?")
+                if path == "/admin/drain":
+                    self._handle_admin_drain(query)
+                    return
+                if path == "/admin/undrain":
+                    self._handle_admin_undrain(query)
+                    return
+                if path not in ("/embed", "/neighbors"):
+                    self.send_error(404)
+                    return
+                body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+                headers = {}
+                shape = self.headers.get("X-Image-Shape")
+                if shape:
+                    headers["X-Image-Shape"] = shape
+                if not server._admit():
+                    # load shedding: a counted 503 + Retry-After, never
+                    # a silent drop (and it burns error budget)
+                    server.metrics.count("shed")
+                    server.metrics.record_failure()
+                    self._json(
+                        503,
+                        {"error": "router at max_inflight budget", "shed": True},
+                        extra_headers={
+                            "Retry-After": str(
+                                max(1, round(server.shed_retry_after_s))
+                            )
+                        },
+                    )
+                    return
+                try:
+                    status, payload, rep_index = retry_mod.retry_call(
+                        server._attempt_hedged,
+                        self.path,
+                        body,
+                        headers,
+                        site="router." + path.strip("/"),
+                        attempts=server.retry_attempts,
+                        base_delay=server.retry_base_delay_s,
+                        max_delay=server.retry_max_delay_s,
+                        retry_on=(ReplicaAttemptError, ReplicaUnavailableError),
+                    )
+                except OSError as e:
+                    # retries exhausted across the fleet: loud 503
+                    server.metrics.count("failed")
+                    server.metrics.record_failure()
+                    self._json(
+                        503,
+                        {"error": f"fleet dispatch failed: {e}"},
+                        extra_headers={"Retry-After": "1"},
+                    )
+                    return
+                finally:
+                    server._release()
+                server.metrics.record_request(
+                    time.perf_counter() - t0, ok=status == 200
+                )
+                if isinstance(payload, dict):
+                    # replica attribution next to the replica-scoped
+                    # request_id (r<i>-<seq>) the replica minted
+                    payload.setdefault("replica", rep_index)
+                self._json(status, payload)
+
+            def _handle_admin_drain(self, query):
+                idx = _parse_replica(query, len(server._replicas))
+                if idx is None:
+                    self._json(400, {"error": "need replica=<index>"})
+                    return
+                restart = _query_flag(query, "restart", default=None)
+                started = server.drain_replica(idx, restart=restart)
+                with server._fleet_lock:
+                    snap = server._replicas[idx].snapshot()
+                self._json(202, {"accepted": started, "replica": snap})
+
+            def _handle_admin_undrain(self, query):
+                idx = _parse_replica(query, len(server._replicas))
+                if idx is None:
+                    self._json(400, {"error": "need replica=<index>"})
+                    return
+                server.undrain_replica(idx)
+                with server._fleet_lock:
+                    snap = server._replicas[idx].snapshot()
+                self._json(200, {"replica": snap})
+
+            def _json(self, code, obj, extra_headers=None):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (extra_headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # silence per-request stderr lines
+                pass
+
+        from moco_tpu.serve.server import _QuietHTTPServer
+
+        self._server = _QuietHTTPServer((host, int(port)), Handler)
+        self.host = host
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="router_http", daemon=True
+        )
+        self._thread.start()
+        self._health_thread = threading.Thread(
+            target=self._health_loop, name="router_health", daemon=True
+        )
+        self._health_thread.start()
+        self._drainer = threading.Thread(
+            target=self._drain_loop, name="router_drain", daemon=True
+        )
+        self._drainer.start()
+        self._flusher = threading.Thread(
+            target=self._flush_loop, args=(float(metrics_flush_s),),
+            name="router_metrics_flush", daemon=True,
+        )
+        self._flusher.start()
+
+    # -- dispatch ---------------------------------------------------------
+
+    def _admit(self) -> bool:
+        with self._fleet_lock:
+            if self._active >= self.max_inflight:
+                return False
+            self._active += 1
+            return True
+
+    def _release(self) -> None:
+        with self._fleet_lock:
+            self._active -= 1
+
+    def _acquire(self, exclude=()) -> Optional[ReplicaHandle]:
+        """Claim a replica for one attempt: admitted (healthy, not
+        draining), breaker willing, fewest in-flight first. Books the
+        in-flight/dispatch counters under the fleet lock."""
+        with self._fleet_lock:
+            cands = sorted(
+                (
+                    r for r in self._replicas
+                    if r.admitted and r.index not in exclude
+                ),
+                key=lambda r: (r.inflight, r.dispatched, r.index),
+            )
+            # a breaker due for its half-open probe takes the request
+            # first: recovery needs live traffic, a failed probe is
+            # retried on a closed replica anyway, and try_acquire gates
+            # this to one probe per cooldown — an OPEN breaker inside
+            # its cooldown says no and the request flows to the closed
+            # replicas below
+            for r in cands:
+                if r.breaker.state != BREAKER_CLOSED and r.breaker.try_acquire():
+                    r.inflight += 1
+                    r.dispatched += 1
+                    return r
+            for r in cands:
+                if r.breaker.state == BREAKER_CLOSED and r.breaker.try_acquire():
+                    r.inflight += 1
+                    r.dispatched += 1
+                    return r
+        return None
+
+    def _finish(self, rep: ReplicaHandle, ok: bool) -> None:
+        with self._fleet_lock:
+            rep.inflight = max(0, rep.inflight - 1)
+            if ok:
+                rep.breaker.record_success()
+            else:
+                rep.breaker.record_failure()
+
+    def _try_replica(self, rep: ReplicaHandle, path_q: str, body: bytes, headers: dict):
+        """One attempt against one replica (runs on the dispatch pool;
+        no locks held across the network I/O). Returns (status, payload,
+        replica_index); raises ReplicaAttemptError on anything worth
+        re-routing."""
+        req = urllib.request.Request(rep.url + path_q, data=body, headers=dict(headers))
+        try:
+            with urllib.request.urlopen(req, timeout=self.replica_timeout_s) as resp:
+                payload = json.loads(resp.read())
+                status = resp.status
+        except urllib.error.HTTPError as e:
+            if 400 <= e.code < 500:
+                # the replica is alive and judged the request itself: a
+                # client error passes through un-retried (breaker success)
+                try:
+                    payload = json.loads(e.read())
+                except ValueError:
+                    payload = {"error": f"replica {rep.index}: HTTP {e.code}"}
+                self._finish(rep, ok=True)
+                return e.code, payload, rep.index
+            self._finish(rep, ok=False)
+            raise ReplicaAttemptError(f"replica {rep.index}: HTTP {e.code}") from e
+        except (OSError, TimeoutError) as e:  # URLError/socket resets/timeouts
+            self._finish(rep, ok=False)
+            raise ReplicaAttemptError(f"replica {rep.index}: {e!r}") from e
+        except ValueError as e:  # torn/garbled response body
+            self._finish(rep, ok=False)
+            raise ReplicaAttemptError(
+                f"replica {rep.index}: bad response ({e!r})"
+            ) from e
+        self._finish(rep, ok=True)
+        return status, payload, rep.index
+
+    def _hedge_delay_s(self) -> Optional[float]:
+        if not self.hedge:
+            return None
+        p99 = self.metrics.p99_ms()
+        ms = max(self.hedge_min_ms, (p99 or 0.0) * self.hedge_p99_factor)
+        return ms / 1e3
+
+    def _attempt_hedged(self, path_q: str, body: bytes, headers: dict):
+        """One retry-round: dispatch to the best replica; if it outlives
+        the hedge delay, duplicate to a second one and take the first
+        success (first-winner — the loser's response is discarded when
+        it lands; urlopen cannot be cancelled mid-flight). Raises an
+        OSError subclass when the round produced no success, which is
+        what the retry layer backs off on."""
+        rep = self._acquire()
+        if rep is None:
+            raise ReplicaUnavailableError("no admitted replica to dispatch to")
+        primary = self._pool.submit(self._try_replica, rep, path_q, body, headers)
+        delay = self._hedge_delay_s()
+        if delay is None:
+            return primary.result()
+        try:
+            return primary.result(timeout=delay)
+        except concurrent.futures.TimeoutError:
+            pass  # primary is slow, not failed: hedge it
+        second = self._acquire(exclude=(rep.index,))
+        attempts = [primary]
+        if second is not None:
+            self.metrics.count("hedges")
+            attempts.append(
+                self._pool.submit(self._try_replica, second, path_q, body, headers)
+            )
+        pending = set(attempts)
+        errors = []
+        while pending:
+            done, pending = concurrent.futures.wait(
+                pending, return_when=concurrent.futures.FIRST_COMPLETED
+            )
+            for fut in done:
+                err = fut.exception()
+                if err is None:
+                    if len(attempts) == 2 and fut is attempts[1]:
+                        self.metrics.count("hedge_wins")
+                    return fut.result()
+                errors.append(err)
+        raise ReplicaUnavailableError(
+            "all attempts failed this round: "
+            + "; ".join(repr(e) for e in errors)
+        )
+
+    # -- health -----------------------------------------------------------
+
+    def _probe(self, url: str):
+        """(ok, warm, stats) for one replica — network I/O, call with
+        no locks held."""
+        try:
+            with urllib.request.urlopen(
+                url + "/healthz", timeout=self.health_timeout_s
+            ) as r:
+                h = json.loads(r.read())
+        except (OSError, ValueError):
+            return False, False, None
+        stats = None
+        try:
+            with urllib.request.urlopen(
+                url + "/stats", timeout=self.health_timeout_s
+            ) as r:
+                stats = json.loads(r.read())
+        except (OSError, ValueError):
+            pass
+        return bool(h.get("ok")), bool(h.get("warm")), stats
+
+    def _poll_health(self) -> None:
+        with self._fleet_lock:
+            targets = [(r.index, r.url) for r in self._replicas]
+        for index, url in targets:
+            ok, warm, stats = self._probe(url)
+            with self._fleet_lock:
+                rep = self._replicas[index]
+                if rep.url != url:
+                    continue  # replica moved mid-poll; drop the stale probe
+                rep.healthy = ok
+                rep.warm = warm
+                if stats is not None:
+                    rep.stats = stats
+
+    def _health_loop(self) -> None:
+        while not self._stop.wait(self.health_interval_s):
+            self._poll_health()
+
+    # -- drain ------------------------------------------------------------
+
+    def drain_replica(self, index: int, restart: Optional[bool] = None) -> bool:
+        """Stop dispatching to replica `index`, wait out its in-flight
+        requests, then (default, when a supervisor is attached) restart
+        it and re-admit on healthy. Asynchronous: returns immediately
+        (False = already draining); poll `/admin/replicas` for phase."""
+        if restart is None:
+            restart = self._supervisor is not None
+        with self._fleet_lock:
+            rep = self._replicas[index]
+            if rep.draining:
+                return False
+            rep.draining = True
+            rep.drain_phase = "waiting_inflight"
+        self.metrics.count("drains")
+        self._drain_q.put((index, bool(restart)))
+        return True
+
+    def undrain_replica(self, index: int) -> None:
+        with self._fleet_lock:
+            rep = self._replicas[index]
+            rep.draining = False
+            rep.drain_phase = None
+            rep.breaker.reset()
+
+    def _set_phase(self, rep: ReplicaHandle, phase: Optional[str]) -> None:
+        with self._fleet_lock:
+            rep.drain_phase = phase
+
+    def _drain_loop(self) -> None:
+        """The single drain worker: serializes drain/restart jobs (one
+        replica leaves the fleet at a time — a fleet-wide drain storm
+        cannot empty the rotation)."""
+        while not self._stop.is_set():
+            try:
+                index, restart = self._drain_q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            try:
+                self._do_drain(index, restart)
+            except Exception as e:  # a failed drain must not kill the worker
+                print(f"router: drain of replica {index} failed: {e!r}", flush=True)
+                self._set_phase(self._replicas[index], "drain_failed")
+
+    def _do_drain(self, index: int, restart: bool) -> None:
+        rep = self._replicas[index]
+        deadline = time.monotonic() + self.drain_timeout_s
+        while time.monotonic() < deadline:
+            with self._fleet_lock:
+                inflight = rep.inflight
+            if inflight == 0:
+                break
+            time.sleep(0.05)
+        if restart and self._supervisor is not None:
+            self._set_phase(rep, "restarting")
+            self._supervisor.restart_replica(index)
+            self._set_phase(rep, "readmitting")
+            deadline = time.monotonic() + self.readmit_timeout_s
+            ok = False
+            while time.monotonic() < deadline and not self._stop.is_set():
+                ok, warm, stats = self._probe(rep.url)
+                if ok:
+                    break
+                time.sleep(0.2)
+            with self._fleet_lock:
+                rep.healthy = ok
+                rep.draining = False
+                rep.drain_phase = None if ok else "readmit_timeout"
+                rep.breaker.reset()
+        else:
+            # no restart: drain the replica's own batcher (flushes every
+            # accepted request) and park it out of rotation
+            try:
+                req = urllib.request.Request(
+                    rep.url + f"/admin/drain?timeout={self.drain_timeout_s:.1f}",
+                    data=b"",
+                )
+                with urllib.request.urlopen(req, timeout=self.drain_timeout_s + 10):
+                    pass
+            except (OSError, ValueError) as e:
+                print(
+                    f"router: replica {index} /admin/drain failed: {e!r}", flush=True
+                )
+            self._set_phase(rep, "drained")
+
+    # -- metrics ----------------------------------------------------------
+
+    def stats(self) -> dict:
+        """The `fleet_serve/*` gauge line: the router's own burn/latency
+        family plus fleet topology, per-replica dispatch counts, and the
+        per-replica burn gauges aggregated min/mean/max (the obs/fleet.py
+        pattern). Snapshots fleet state first, THEN takes the metrics
+        lock inside payload() — the two locks never nest."""
+        with self._fleet_lock:
+            snaps = [r.snapshot() for r in self._replicas]
+            replica_stats = [dict(r.stats) for r in self._replicas]
+            active = self._active
+        out = self.metrics.payload()
+        out["fleet_serve/replicas"] = len(snaps)
+        out["fleet_serve/replicas_healthy"] = sum(
+            1 for s in snaps if s["healthy"] and not s["draining"]
+        )
+        out["fleet_serve/inflight"] = active
+        out["fleet_serve/breaker_open"] = sum(
+            1 for s in snaps if s["breaker"] == BREAKER_OPEN
+        )
+        out["fleet_serve/breaker_trips"] = sum(s["breaker_trips"] for s in snaps)
+        for s in snaps:
+            out[f"fleet_serve/dispatch_{s['index']}"] = s["dispatched"]
+        burn_keys = set()
+        for st in replica_stats:
+            burn_keys |= {k for k in st if k.startswith("serve/burn_rate_")}
+        for k in sorted(burn_keys):
+            vals = [
+                st[k] for st in replica_stats if st.get(k) is not None
+            ]
+            base = "fleet_serve/" + k.split("/", 1)[1]
+            out[base + "_min"] = min(vals) if vals else None
+            out[base + "_mean"] = sum(vals) / len(vals) if vals else None
+            out[base + "_max"] = max(vals) if vals else None
+        router_retries = {
+            k: v
+            for k, v in retry_mod.snapshot().items()
+            if k.startswith("router.")
+        }
+        out["fleet_serve/retries"] = sum(router_retries.values())
+        if router_retries:
+            out["io_retries"] = router_retries
+        return out
+
+    def _flush_loop(self, interval: float) -> None:
+        step = 0
+        while not self._stop.wait(interval):
+            step += 1
+            self._write_metrics(step)
+        self._write_metrics(step + 1)  # the run's last gauges land too
+
+    def _write_metrics(self, step: int) -> None:
+        try:
+            payload = self.stats()
+            if self._sink is not None:
+                self._sink.write(step, payload)
+        except Exception as e:  # metrics must never take the router down
+            print(f"WARNING: router metrics sink failed: {e!r}", flush=True)
+
+    # -- lifecycle --------------------------------------------------------
+
+    def close(self) -> None:
+        """Stop the poller/flusher/drain worker, shut HTTP, join all
+        four threads, and retire the dispatch pool (JX011 discipline)."""
+        self._stop.set()
+        self._health_thread.join(timeout=10.0)
+        self._flusher.join(timeout=10.0)
+        self._drainer.join(timeout=self.drain_timeout_s + 30.0)
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=10.0)
+        self._pool.shutdown(wait=True, cancel_futures=True)
+
+
+def _query_param(query: str, name: str) -> Optional[str]:
+    for part in query.split("&"):
+        if part.startswith(name + "="):
+            return part[len(name) + 1 :] or None
+    return None
+
+
+def _parse_replica(query: str, num_replicas: int) -> Optional[int]:
+    val = _query_param(query, "replica")
+    if val is None:
+        return None
+    try:
+        idx = int(val)
+    except ValueError:
+        return None
+    if not 0 <= idx < num_replicas:
+        return None
+    return idx
+
+
+def _query_flag(query: str, name: str, default=None):
+    val = _query_param(query, name)
+    if val is None:
+        return default
+    return val not in ("0", "false", "no")
+
+
+__all__ = [
+    "BREAKER_CLOSED",
+    "BREAKER_HALF_OPEN",
+    "BREAKER_OPEN",
+    "CircuitBreaker",
+    "FleetRouter",
+    "ReplicaAttemptError",
+    "ReplicaHandle",
+    "ReplicaUnavailableError",
+    "RouterMetrics",
+]

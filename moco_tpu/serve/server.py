@@ -18,7 +18,13 @@ Endpoints:
   `scripts/serve_ingest.py` drives from a training checkpoint dir);
   IVF cell membership and the int8 mirror follow incrementally.
 - `GET /stats` — the live `serve/*` gauge snapshot as JSON.
-- `GET /healthz` — `{"ok": true, "warm": ...}` once the AOT warmup ran.
+- `GET /healthz` — `{"ok": true, "warm": ..., "draining": false}` once
+  the AOT warmup ran; `ok` flips false while draining so a fleet router
+  stops dispatching here before the batcher's intake actually shuts.
+- `POST /admin/drain` — graceful shutdown of THIS replica: healthz goes
+  not-ok, the batcher flushes every accepted request (`drain()`, zero
+  failed futures), then intake closes. The fleet router calls this (or
+  the SIGTERM path does, via `replica_main`) before a restart.
 
 Recall estimation: with an approximate `neighbors_mode`, every
 `recall_sample_every`-th neighbors micro-batch ALSO runs the exact
@@ -66,6 +72,7 @@ import http.server
 import json
 import os
 import socket
+import sys
 import threading
 import time
 from collections import deque
@@ -85,6 +92,19 @@ from moco_tpu.utils import faults
 
 DEFAULT_NEIGHBORS_K = 5
 DEFAULT_RECALL_SAMPLE_EVERY = 8
+
+
+class _QuietHTTPServer(http.server.ThreadingHTTPServer):
+    """ThreadingHTTPServer that stays quiet when a CLIENT abandons the
+    connection mid-response — routine under a fleet router (a hedge
+    loser's response is discarded, a health probe times out and hangs
+    up), not worth a traceback per occurrence."""
+
+    def handle_error(self, request, client_address):
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (BrokenPipeError, ConnectionResetError)):
+            return
+        super().handle_error(request, client_address)
 
 
 class ServeServer:
@@ -206,13 +226,22 @@ class ServeServer:
             slo_ms=slo_ms,
             metrics=self.metrics,
         )
+        # drain flag (an Event: set from any thread — the /admin/drain
+        # handler or the SIGTERM path — read by every healthz handler)
+        self._draining = threading.Event()
         server = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (http.server API)
                 path = self.path.split("?")[0]
                 if path == "/healthz":
-                    self._json(200, {"ok": True, "warm": server.engine.recompiles_after_warmup == 0})
+                    draining = server._draining.is_set()
+                    self._json(200, {
+                        "ok": not draining,
+                        "warm": server.engine.recompiles_after_warmup == 0,
+                        "draining": draining,
+                        "replica": server.replica_index,
+                    })
                 elif path == "/stats":
                     self._json(200, server.stats())
                 elif path == "/debug/flight":
@@ -235,9 +264,16 @@ class ServeServer:
                 if path == "/ingest":
                     self._handle_ingest()
                     return
+                if path == "/admin/drain":
+                    self._handle_drain(query)
+                    return
                 if path not in ("/embed", "/neighbors"):
                     self.send_error(404)
                     return
+                # chaos hook: kill@replica=i[:at=K] dies HERE, with the
+                # request (and any coalesced riders) in flight — the
+                # router's breaker + retry path must absorb the reset
+                faults.maybe_kill_replica(server.replica_index)
                 faults.maybe_slow("serve.ingress")
                 try:
                     images = self._read_images()
@@ -288,6 +324,23 @@ class ServeServer:
                 if trace is not None:
                     trace.stamp("respond", t_respond, time.perf_counter())
                     server._complete(trace)
+
+            def _handle_drain(self, query):
+                """Graceful drain of this replica, synchronously: the
+                response does not land until every accepted request has
+                flushed (or the timeout passed) — the caller can treat a
+                200 with drained=true as 'safe to SIGTERM/restart'."""
+                try:
+                    timeout = float(_query_param(query, "timeout") or 30.0)
+                except ValueError:
+                    self._json(400, {"error": "bad timeout parameter"})
+                    return
+                drained = server.drain(timeout=timeout)
+                self._json(200, {
+                    "draining": True,
+                    "drained": drained,
+                    "replica": server.replica_index,
+                })
 
             def _handle_ingest(self):
                 """FIFO-ingest a raw f32 row block into the live index —
@@ -362,7 +415,7 @@ class ServeServer:
                 pass
 
         resolved = resolve_serve_port(port, metrics_port, process_index)
-        self._server = http.server.ThreadingHTTPServer((host, resolved), Handler)
+        self._server = _QuietHTTPServer((host, resolved), Handler)
         self.host = host
         self.port = self._server.server_address[1]
         self._thread = threading.Thread(
@@ -563,6 +616,20 @@ class ServeServer:
             print(f"WARNING: serve metrics sink failed: {e!r}", flush=True)
 
     # -- lifecycle -------------------------------------------------------
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Graceful shutdown, phase one: healthz flips not-ok (a fleet
+        router stops dispatching here), then the batcher drains — every
+        request already accepted is flushed, not failed. The HTTP server
+        itself stays up (healthz must answer mid-drain); follow with
+        `close()`. Idempotent; True = the flush finished in time. This
+        is the server half of the SIGTERM path (`replica_main`) and of
+        `POST /admin/drain`."""
+        already = self._draining.is_set()
+        self._draining.set()
+        if already and self.batcher.closed:
+            return True  # second drain call: nothing left to flush
+        return self.batcher.drain(timeout=timeout)
 
     def close(self) -> None:
         """Shut down HTTP, batcher, and flusher; join all three threads

@@ -67,6 +67,20 @@ Spec grammar: comma-separated faults, each `kind@key=val[:key=val...]`:
                                   loss deterministically — the elastic
                                   checkpoint-and-rescale chaos harness
                                   (scripts/elastic_smoke.py)
+    kill@replica=i[:at=K]         serving replica i dies (os._exit with
+                                  KILL_EXIT_CODE, no cleanup) while
+                                  handling its Kth /embed or /neighbors
+                                  POST (1-based; default 1) — sudden
+                                  replica death mid-request, the fleet
+                                  chaos harness: the router must retry
+                                  the in-flight request elsewhere and
+                                  the ReplicaSupervisor must restart +
+                                  re-warm the corpse
+                                  (scripts/fleet_serve_smoke.py). The
+                                  supervisor strips kill@replica rules
+                                  from the reborn process's MOCO_FAULTS
+                                  (strip_replica_kills) so one rule is
+                                  one death, not a crash loop
     slow@site=S:ms=X[:at=K:times=M]
                                   sleep X *milliseconds* on calls
                                   K..K+M-1 (1-based; default: every
@@ -112,7 +126,7 @@ KINDS = (
 # detect via heartbeat staleness, not a graceful shutdown.
 KILL_EXIT_CODE = 113
 
-_INT_KEYS = ("step", "at", "times", "host")
+_INT_KEYS = ("step", "at", "times", "host", "replica")
 _FLOAT_KEYS = ("seconds", "ms")
 _STR_KEYS = ("site",)
 
@@ -145,8 +159,16 @@ class FaultPlan:
                     kv[k] = v
                 else:
                     raise ValueError(f"unknown fault param {k!r} in {part!r}")
-            if kind == "kill" and "host" not in kv:
-                raise ValueError(f"kill fault {part!r} needs host=<process index>")
+            if kind == "kill" and "host" not in kv and "replica" not in kv:
+                raise ValueError(
+                    f"kill fault {part!r} needs host=<process index> "
+                    f"or replica=<serving replica index>"
+                )
+            if kind == "kill" and "host" in kv and "replica" in kv:
+                raise ValueError(
+                    f"kill fault {part!r}: host= (training harness) and "
+                    f"replica= (serving harness) are mutually exclusive"
+                )
             self.rules.append((kind, kv))
         self._lock = threading.Lock()
         self._io_counts: Counter = Counter()  # site -> reads seen
@@ -229,7 +251,9 @@ class FaultPlan:
         stamp simulated host i's heartbeat file with an infinitely stale
         timestamp so the survivors' real staleness detection fires."""
         for i, (kind, p) in enumerate(self.rules):
-            if kind != "kill" or step < p.get("at", 1):
+            # replica-keyed kills belong to maybe_kill_replica (the
+            # serving fleet harness), not the training-host path
+            if kind != "kill" or "host" not in p or step < p.get("at", 1):
                 continue
             host = p["host"]
             if num_processes > 1:
@@ -260,6 +284,28 @@ class FaultPlan:
                     f"at step {step}",
                     flush=True,
                 )
+
+    def maybe_kill_replica(self, replica_index: int) -> None:
+        """`kill@replica=i[:at=K]` — sudden serving-replica death, keyed
+        on this replica's own request counter (the Kth /embed//neighbors
+        POST it handles), so the death lands mid-burst deterministically
+        regardless of how the router spread the load. `os._exit`: no
+        drain, no metrics flush, the socket just resets — exactly the
+        failure the router's breaker + retry path must absorb."""
+        key = f"kill_replica:{int(replica_index)}"
+        with self._lock:
+            self._io_counts[key] += 1
+            n = self._io_counts[key]
+        for kind, p in self.rules:
+            if kind != "kill" or p.get("replica") != int(replica_index):
+                continue
+            if n >= p.get("at", 1):
+                print(
+                    f"injected fault: killing replica {replica_index} "
+                    f"(this process) on request #{n}",
+                    flush=True,
+                )
+                os._exit(KILL_EXIT_CODE)  # sudden death: no cleanup, no flush
 
     def deadlock_marker(self, site: str) -> bool:
         """True when a `deadlock@site=L` rule targets this tsan lock
@@ -387,6 +433,31 @@ def maybe_kill_host(
 ) -> None:
     if _PLAN is not None:
         _PLAN.maybe_kill_host(step, workdir, process_index, num_processes)
+
+
+def maybe_kill_replica(replica_index: int) -> None:
+    if _PLAN is not None:
+        _PLAN.maybe_kill_replica(replica_index)
+
+
+def strip_replica_kills(spec: Optional[str]) -> str:
+    """Remove `kill@replica=...` rules from a spec string — the
+    ReplicaSupervisor rewrites a reborn replica's MOCO_FAULTS with this
+    so a kill rule fires exactly once instead of crash-looping the
+    respawn. Other rules pass through verbatim (order preserved)."""
+    if not spec:
+        return ""
+    kept = []
+    for part in spec.split(","):
+        token = part.strip()
+        kind, _, params = token.partition("@")
+        if kind == "kill" and any(
+            tok.partition("=")[0] == "replica" for tok in params.split(":")
+        ):
+            continue
+        if token:
+            kept.append(token)
+    return ",".join(kept)
 
 
 def diverge_marker(site: str) -> str:

@@ -1,0 +1,401 @@
+#!/usr/bin/env python
+"""Serving-fleet chaos smoke: the router + supervisor story (ISSUE 16),
+asserted hard.
+
+    JAX_PLATFORMS=cpu python scripts/fleet_serve_smoke.py [--workdir DIR]
+
+The story, executable:
+
+1. a toy pretraining checkpoint is written (serve_smoke's maker) and a
+   `ReplicaSupervisor` boots THREE `replica_main` processes from it,
+   each binding its pre-claimed port only after AOT warmup;
+2. per-replica chaos is planted through the supervisor's `extra_env`:
+   replica 1 carries `kill@replica=1:at=5` (sudden `os._exit` mid-
+   request on its 5th data POST) and replica 2 carries a PERMANENT
+   `slow@site=serve.engine_execute:ms=2500` (every request there
+   outlives the router's hedge delay — the deterministic tail);
+3. a `FleetRouter` fronts the fleet and a mixed `/embed` +
+   `/neighbors` burst fires from concurrent closed-loop clients —
+   asserts ZERO failed client requests: the kill is absorbed by
+   breaker + bounded retry (counted: `fleet_serve/retries`,
+   `fleet_serve/breaker_trips`), the injected tail by hedging
+   (counted: `fleet_serve/hedges`, `fleet_serve/hedge_wins` — first
+   success wins), and every response's `replica` attribution matches
+   its replica-minted `r<i>-` request id;
+4. the supervisor's monitor respawns the corpse (exactly one `exit`
+   event with rc=KILL_EXIT_CODE, reason "crash"), scrubs the kill rule
+   from the reborn env, waits out the AOT re-warmup, re-plays the warm
+   rows through `/ingest` (the reborn replica reports them in
+   `serve/ingested_rows` — a WARM rejoin, not an empty index), and the
+   router re-admits it into live rotation;
+5. the drain leg: `POST /admin/drain?replica=0` under live traffic —
+   dispatch stops, in-flight waits out, the supervisor restarts the
+   replica gracefully (SIGTERM → batcher drain → respawn → re-warm),
+   the router re-admits on healthy, and NOT ONE background request
+   failed across the whole cycle;
+6. the fanout-ingest leg: `scripts/serve_ingest.py`'s `--fanout` path
+   discovers the topology from `/admin/replicas` and lands a fresh
+   block on EVERY replica (per-replica `ingest.post.r<i>` retry
+   sites);
+7. final gates: `fleet_serve/burn_rate_60s` < 1.0 (the chaos never
+   exhausted the client-observed error budget), the flushed
+   `fleet_serve/*` metrics lines schema-strict, and mocolint clean on
+   the fleet modules (JX011/JX012/JX013 — the threaded router must
+   lint clean, not just run clean).
+
+CI runs this in the tier-1 job; the router metrics stream, the summary
+JSON, and the supervisor event log upload as artifacts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+NUM_REPLICAS = 3
+KILLED_REPLICA = 1
+SLOWED_REPLICA = 2
+DRAINED_REPLICA = 0
+BUCKETS = (1, 8)
+REQUEST_SIZES = (1, 2, 4)
+BURST_REQUESTS = 72
+BURST_CLIENTS = 6
+WARM_ROWS = 32
+# per-replica batcher SLO (coalescing deadline) vs the router's client-
+# observed SLO: same two-knob split as serve_smoke — the router bar is
+# generous because its latency includes a replica flush AND (for the
+# slowed replica) a full hedge delay before the fast twin answers.
+SERVER_SLO_MS = float(os.environ.get("FLEET_SMOKE_SERVER_SLO_MS", 1000.0))
+ROUTER_SLO_MS = float(os.environ.get("FLEET_SMOKE_ROUTER_SLO_MS", 4000.0))
+# hedge floor: above the healthy replicas' worst latency (~one flush),
+# well under the slowed replica's injected 2.5s stage — healthy traffic
+# never hedges, slowed traffic always does
+HEDGE_MIN_MS = float(os.environ.get("FLEET_SMOKE_HEDGE_MIN_MS", 1500.0))
+SLOW_MS = 2500.0
+KILL_AT = 5  # replica 1 dies handling its 5th data POST — mid-burst
+RESPAWN_DEADLINE_S = 420.0
+DRAIN_DEADLINE_S = 420.0
+
+
+def _get(url: str, timeout: float = 10.0) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def run_smoke(workdir: str) -> dict:
+    import numpy as np
+
+    import serve_smoke
+    from moco_tpu.obs import schema
+    from moco_tpu.obs.sinks import JsonlSink
+    from moco_tpu.serve.fleet import ReplicaSupervisor
+    from moco_tpu.serve.router import FleetRouter
+    from moco_tpu.utils.faults import KILL_EXIT_CODE
+
+    ckpt_dir = os.path.join(workdir, "toy_ckpt")
+    serve_smoke.make_toy_checkpoint(ckpt_dir)
+    rng = np.random.default_rng(0)
+    warm_rows = rng.standard_normal((WARM_ROWS, 16)).astype(np.float32)
+
+    sup = ReplicaSupervisor(
+        NUM_REPLICAS,
+        ckpt_dir=ckpt_dir,
+        workdir=workdir,
+        buckets=BUCKETS,
+        slo_ms=SERVER_SLO_MS,
+        extra_env={
+            KILLED_REPLICA: {"MOCO_FAULTS": f"kill@replica={KILLED_REPLICA}:at={KILL_AT}"},
+            SLOWED_REPLICA: {
+                "MOCO_FAULTS": f"slow@site=serve.engine_execute:ms={SLOW_MS:.0f}"
+            },
+        },
+        warm_rows_fn=lambda: warm_rows,
+        boot_timeout_s=RESPAWN_DEADLINE_S,
+        monitor_interval_s=0.25,
+        restart_backoff_s=0.5,
+    )
+    print(f"booting {NUM_REPLICAS} replicas (AOT warmup each)...", flush=True)
+    t_boot = time.monotonic()
+    sup.start()
+    print(f"fleet healthy in {time.monotonic() - t_boot:.1f}s: {sup.urls()}", flush=True)
+
+    sink = JsonlSink(workdir)
+    router = FleetRouter(
+        supervisor=sup,
+        slo_ms=ROUTER_SLO_MS,
+        slo_objective=0.9,
+        sink=sink,
+        metrics_flush_s=0.5,
+        health_interval_s=0.25,
+        retry_attempts=4,
+        retry_base_delay_s=0.25,
+        hedge_min_ms=HEDGE_MIN_MS,
+        max_inflight=32,
+        # one connection-reset is a trip: the smoke wants the breaker
+        # OBSERVABLY in the story (fleet_serve/breaker_trips >= 1), and
+        # a killed replica fails hard anyway
+        breaker_fail_threshold=1,
+        breaker_cooldown_s=1.0,
+        drain_timeout_s=60.0,
+        readmit_timeout_s=DRAIN_DEADLINE_S,
+    )
+    base = f"http://127.0.0.1:{router.port}"
+    canned = {
+        n: rng.integers(0, 255, (n, serve_smoke.IMAGE_SIZE, serve_smoke.IMAGE_SIZE, 3),
+                        np.uint8)
+        for n in REQUEST_SIZES
+    }
+    failures: list[str] = []
+    replicas_seen: set = set()
+    lock = threading.Lock()
+
+    def post(path: str, imgs) -> dict:
+        req = urllib.request.Request(
+            base + path,
+            data=imgs.tobytes(),
+            headers={"X-Image-Shape": ",".join(map(str, imgs.shape))},
+        )
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return json.loads(r.read())
+
+    def check_response(out: dict, n: int) -> None:
+        emb = np.asarray(out["embedding"], np.float32)
+        if emb.shape[0] != n:
+            raise ValueError(f"expected {n} rows, got {emb.shape}")
+        # replica attribution: the router's blame matches the replica-
+        # scoped request id the replica itself minted
+        rid, rep = out["request_id"], out["replica"]
+        if not rid.startswith(f"r{rep}-"):
+            raise ValueError(f"attribution mismatch: id {rid} vs replica {rep}")
+        with lock:
+            replicas_seen.add(rep)
+
+    def client(ci: int, num: int) -> None:
+        crng = np.random.default_rng(1000 + ci)
+        for j in range(num):
+            n = int(crng.choice(REQUEST_SIZES))
+            path = "/neighbors?k=3" if (ci + j) % 2 == 0 else "/embed"
+            try:
+                check_response(post(path, canned[n]), n)
+            except Exception as e:
+                with lock:
+                    failures.append(f"client {ci} req {j}: {e!r}")
+                return
+
+    summary: dict = {"workdir": workdir}
+    try:
+        # -- the chaos burst: kill@replica fires mid-burst -----------------
+        print(f"burst: {BURST_REQUESTS} requests from {BURST_CLIENTS} clients "
+              f"(kill@replica={KILLED_REPLICA}:at={KILL_AT} armed, replica "
+              f"{SLOWED_REPLICA} permanently slowed {SLOW_MS:.0f}ms)", flush=True)
+        t0 = time.monotonic()
+        threads = [
+            threading.Thread(target=client, args=(ci, BURST_REQUESTS // BURST_CLIENTS))
+            for ci in range(BURST_CLIENTS)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        burst_s = time.monotonic() - t0
+        assert not failures, f"{len(failures)} requests failed: {failures[:5]}"
+        print(f"burst clean in {burst_s:.1f}s; replicas seen: {sorted(replicas_seen)}",
+              flush=True)
+
+        # -- the corpse respawns, scrubbed and WARM ------------------------
+        deadline = time.monotonic() + RESPAWN_DEADLINE_S
+        while time.monotonic() < deadline:
+            kinds = [(e["kind"], e["replica"]) for e in sup.events()]
+            if ("restart", KILLED_REPLICA) in kinds:
+                break
+            time.sleep(0.25)
+        events = sup.events()
+        crashes = [
+            e for e in events
+            if e["kind"] == "exit" and e["replica"] == KILLED_REPLICA
+            and e.get("reason") == "crash"
+        ]
+        assert crashes, f"no crash event for replica {KILLED_REPLICA}: {events}"
+        assert crashes[0]["rc"] == KILL_EXIT_CODE, crashes
+        warms = [
+            e for e in events if e["kind"] == "warm" and e["replica"] == KILLED_REPLICA
+        ]
+        assert warms and warms[0]["rows"] == WARM_ROWS, warms
+        reborn = _get(sup.url(KILLED_REPLICA) + "/healthz")
+        assert reborn["ok"] and reborn["warm"], reborn
+        reborn_stats = _get(sup.url(KILLED_REPLICA) + "/stats")
+        assert reborn_stats["serve/ingested_rows"] == WARM_ROWS, (
+            f"reborn replica not warm: {reborn_stats.get('serve/ingested_rows')}"
+        )
+        print(f"replica {KILLED_REPLICA} respawned warm "
+              f"(rc={crashes[0]['rc']}, {WARM_ROWS} rows replayed)", flush=True)
+        # ...and the ROUTER re-admits it into live rotation
+        deadline = time.monotonic() + 60.0
+        readmitted = False
+        while time.monotonic() < deadline and not readmitted:
+            out = post("/embed", canned[1])
+            readmitted = out["replica"] == KILLED_REPLICA
+        assert readmitted, "reborn replica never took router traffic again"
+
+        # -- drain/restart under live traffic: zero dropped ---------------
+        stop = threading.Event()
+        drain_failures: list[str] = []
+
+        def background() -> None:
+            while not stop.is_set():
+                try:
+                    check_response(post("/embed", canned[1]), 1)
+                except Exception as e:
+                    with lock:
+                        drain_failures.append(repr(e))
+                time.sleep(0.05)
+
+        bg = [threading.Thread(target=background) for _ in range(2)]
+        for t in bg:
+            t.start()
+        try:
+            time.sleep(1.0)
+            req = urllib.request.Request(
+                base + f"/admin/drain?replica={DRAINED_REPLICA}", data=b""
+            )
+            with urllib.request.urlopen(req, timeout=30) as r:
+                assert r.status == 202 and json.loads(r.read())["accepted"]
+            deadline = time.monotonic() + DRAIN_DEADLINE_S
+            snap = None
+            while time.monotonic() < deadline:
+                snap = next(
+                    s for s in _get(base + "/admin/replicas")["replicas"]
+                    if s["index"] == DRAINED_REPLICA
+                )
+                if not snap["draining"] and snap["healthy"]:
+                    break
+                time.sleep(0.5)
+            assert snap and snap["healthy"] and not snap["draining"], (
+                f"replica {DRAINED_REPLICA} never rejoined after drain: {snap}"
+            )
+        finally:
+            stop.set()
+            for t in bg:
+                t.join(timeout=60)
+        assert not drain_failures, (
+            f"{len(drain_failures)} requests failed during the drain/restart "
+            f"cycle: {drain_failures[:5]}"
+        )
+        graceful = [
+            e for e in sup.events()
+            if e["kind"] == "exit" and e["replica"] == DRAINED_REPLICA
+            and e.get("reason") == "restart"
+        ]
+        assert graceful, "drain leg produced no graceful restart event"
+        print(f"drain/restart of replica {DRAINED_REPLICA} clean under live traffic",
+              flush=True)
+
+        # -- fanout ingest: the block reaches EVERY replica ----------------
+        import serve_ingest
+
+        fresh = rng.standard_normal((10, 16)).astype(np.float32)
+        results = serve_ingest.fanout_rows(base, fresh)
+        assert set(results) == set(range(NUM_REPLICAS)) and all(
+            v is not None for v in results.values()
+        ), f"fanout dropped a replica: {results}"
+        print(f"fanout ingest landed on all {NUM_REPLICAS} replicas: {results}",
+              flush=True)
+
+        # -- final gates ---------------------------------------------------
+        stats = _get(base + "/stats")
+        assert stats["fleet_serve/replicas_healthy"] == NUM_REPLICAS, stats
+        assert stats["fleet_serve/failed"] == 0, stats
+        assert stats["fleet_serve/shed"] == 0, stats
+        assert stats["fleet_serve/breaker_trips"] >= 1, (
+            "the kill never tripped a breaker"
+        )
+        assert stats["fleet_serve/retries"] >= 1, (
+            "the kill never exercised the retry path"
+        )
+        assert stats["fleet_serve/hedges"] >= 1, (
+            "the slowed replica never triggered a hedge"
+        )
+        assert stats["fleet_serve/hedge_wins"] >= 1, (
+            "no hedge ever beat the slow primary"
+        )
+        burn = stats.get("fleet_serve/burn_rate_60s")
+        assert burn is not None and burn < 1.0, (
+            f"fleet_serve/burn_rate_60s={burn}: the chaos burned the whole "
+            f"client-observed error budget"
+        )
+        summary.update({
+            "burst_requests": BURST_REQUESTS,
+            "burst_seconds": round(burst_s, 2),
+            "failed_requests": 0,
+            "replicas_seen": sorted(replicas_seen),
+            "kill_exit_code": crashes[0]["rc"],
+            "warm_rows_replayed": WARM_ROWS,
+            "burn_rate_60s": burn,
+            "breaker_trips": stats["fleet_serve/breaker_trips"],
+            "retries": stats["fleet_serve/retries"],
+            "hedges": stats["fleet_serve/hedges"],
+            "hedge_wins": stats["fleet_serve/hedge_wins"],
+            "drains": stats["fleet_serve/drains"],
+            "requests_total": stats["fleet_serve/requests"],
+        })
+    finally:
+        router.close()
+        sup.close()
+        sink.close()
+        with open(os.path.join(workdir, "supervisor_events.json"), "w") as f:
+            json.dump(sup.events(), f, indent=2)
+
+    # flushed fleet_serve/* lines must be schema-strict
+    problems = schema.validate_file(os.path.join(workdir, "metrics.jsonl"))
+    assert not problems, f"router metrics schema violations: {problems[:5]}"
+
+    # the threaded fleet modules must LINT clean, not just run clean
+    # (JX011 join discipline, JX012 shared-state, JX013 lock ordering)
+    repo = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+    lint = subprocess.run(
+        [
+            sys.executable, "-m", "moco_tpu.analysis",
+            "moco_tpu/serve/router.py", "moco_tpu/serve/fleet.py",
+            "moco_tpu/serve/replica_main.py", "moco_tpu/serve/batcher.py",
+            "scripts/fleet_serve_smoke.py",
+            "--no-baseline",
+        ],
+        cwd=repo, capture_output=True, text=True,
+    )
+    assert lint.returncode == 0, (
+        f"mocolint findings in the fleet modules:\n{lint.stdout}\n{lint.stderr}"
+    )
+    summary["mocolint_clean"] = True
+
+    with open(os.path.join(workdir, "fleet_serve_smoke.json"), "w") as f:
+        json.dump(summary, f, indent=2)
+    return summary
+
+
+def main() -> int:
+    from moco_tpu.utils.platform import pin_platform_from_env
+
+    pin_platform_from_env()
+    ap = argparse.ArgumentParser(description="serving-fleet router chaos smoke")
+    ap.add_argument("--workdir", default=None)
+    args = ap.parse_args()
+    workdir = args.workdir or tempfile.mkdtemp(prefix="fleet_serve_smoke_")
+    os.makedirs(workdir, exist_ok=True)
+    summary = run_smoke(workdir)
+    print("\n== fleet serve smoke PASS ==")
+    for k, v in summary.items():
+        print(f"  {k}: {v}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

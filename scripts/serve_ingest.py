@@ -10,7 +10,17 @@ endpoint, so a long-lived serving process tracks the dictionary the
 trainer is still building without a restart or a bulk reload.
 
     python scripts/serve_ingest.py --ckpt-dir /run/workdir \
-        --server http://127.0.0.1:8000 [--poll-s 10] [--once]
+        --server http://127.0.0.1:8000 [--poll-s 10] [--once] [--fanout]
+
+With `--fanout` the `--server` URL is a fleet ROUTER
+(serve/router.py): each poll discovers the replica topology from
+`GET /admin/replicas` and posts the fresh block to EVERY replica
+directly (the router does not proxy /ingest — a dictionary update must
+reach all of them, not one). Each replica gets its own retry site
+(`ingest.post.r<i>` in the io_retries ledger), and one replica failing
+its retries degrades to a logged warning, not a lost block for the
+others — a restarting replica catches up through the supervisor's warm
+replay anyway.
 
 Per new checkpoint step: restore the queue + write head, diff against
 the last seen head (the freshly enqueued region is `[old_ptr, new_ptr)`
@@ -59,16 +69,19 @@ def fresh_rows(queue: np.ndarray, old_ptr, new_ptr: int) -> np.ndarray:
     return np.concatenate([queue[old_ptr:], queue[:new_ptr]])
 
 
-def post_rows(server: str, rows: np.ndarray, block: int = DEFAULT_BLOCK) -> int:
+def post_rows(
+    server: str, rows: np.ndarray, block: int = DEFAULT_BLOCK,
+    site: str = "ingest.post",
+) -> int:
     """POST `rows` to the replica's /ingest in bounded blocks; returns
     the replica's reported index row count after the last block.
 
-    Each POST runs through the `utils/retry.py` backoff layer (site
-    `ingest.post`, counted in the per-site io_retries ledger): a replica
-    restart or transient connection reset mid-tail degrades to a logged
-    retry instead of dropping the ingest block — `urllib`'s URLError is
-    an OSError, so the default retry_on covers both network and HTTP
-    transport failures."""
+    Each POST runs through the `utils/retry.py` backoff layer (`site`,
+    counted in the per-site io_retries ledger — fanout mode names one
+    site per replica): a replica restart or transient connection reset
+    mid-tail degrades to a logged retry instead of dropping the ingest
+    block — `urllib`'s URLError is an OSError, so the default retry_on
+    covers both network and HTTP transport failures."""
     from moco_tpu.utils import retry
 
     def _post(chunk: np.ndarray) -> int:
@@ -83,13 +96,48 @@ def post_rows(server: str, rows: np.ndarray, block: int = DEFAULT_BLOCK) -> int:
     index_rows = -1
     for lo in range(0, rows.shape[0], block):
         chunk = np.ascontiguousarray(rows[lo : lo + block], np.float32)
-        index_rows = retry.retry_call(_post, chunk, site="ingest.post")
+        index_rows = retry.retry_call(_post, chunk, site=site)
     return index_rows
 
 
-def poll_once(ckpt_dir: str, server: str, seen: dict, block: int = DEFAULT_BLOCK) -> int:
+def discover_replicas(router: str) -> dict:
+    """{replica_index: base_url} from a fleet router's /admin/replicas
+    (serve/router.py). Every known replica is returned, draining or
+    not — an ingest a drained replica rejects is retried and then
+    skipped with a warning; the supervisor's warm replay realigns it."""
+    with _urlopen(router.rstrip("/") + "/admin/replicas", timeout=10) as r:
+        body = json.loads(r.read())
+    return {int(rep["index"]): rep["url"] for rep in body["replicas"]}
+
+
+def fanout_rows(router: str, rows: np.ndarray, block: int = DEFAULT_BLOCK) -> dict:
+    """POST `rows` to every replica behind `router`, each under its own
+    retry site (`ingest.post.r<i>`). Returns {index: index_rows | None}
+    — None marks a replica whose retries were exhausted (logged; the
+    other replicas still got the block)."""
+    results: dict = {}
+    for index, url in sorted(discover_replicas(router).items()):
+        try:
+            results[index] = post_rows(
+                url, rows, block, site=f"ingest.post.r{index}"
+            )
+        except OSError as e:
+            print(
+                f"WARNING: replica {index} ({url}) dropped an ingest block "
+                f"after retries: {e!r}",
+                flush=True,
+            )
+            results[index] = None
+    return results
+
+
+def poll_once(
+    ckpt_dir: str, server: str, seen: dict, block: int = DEFAULT_BLOCK,
+    fanout: bool = False,
+) -> int:
     """One tail step: ingest anything new; returns rows ingested.
-    `seen` carries {'step', 'ptr'} across polls."""
+    `seen` carries {'step', 'ptr'} across polls. With `fanout`,
+    `server` is a router URL and the block goes to every replica."""
     from moco_tpu.lincls import restore_pretrain_state
     from moco_tpu.utils.checkpoint import CheckpointManager
 
@@ -101,12 +149,24 @@ def poll_once(ckpt_dir: str, server: str, seen: dict, block: int = DEFAULT_BLOCK
     new_ptr = int(state.queue_ptr)
     rows = fresh_rows(queue, seen.get("ptr"), new_ptr)
     if rows.shape[0]:
-        index_rows = post_rows(server, rows, block)
-        print(
-            f"step {step}: ingested {rows.shape[0]} fresh rows "
-            f"(replica index_rows={index_rows})",
-            flush=True,
-        )
+        if fanout:
+            results = fanout_rows(server, rows, block)
+            summary = ", ".join(
+                f"r{i}={'FAILED' if n is None else n}"
+                for i, n in sorted(results.items())
+            )
+            print(
+                f"step {step}: fanned {rows.shape[0]} fresh rows to "
+                f"{len(results)} replicas (index_rows: {summary})",
+                flush=True,
+            )
+        else:
+            index_rows = post_rows(server, rows, block)
+            print(
+                f"step {step}: ingested {rows.shape[0]} fresh rows "
+                f"(replica index_rows={index_rows})",
+                flush=True,
+            )
     seen["step"], seen["ptr"] = step, new_ptr
     return int(rows.shape[0])
 
@@ -121,12 +181,17 @@ def main() -> int:
     ap.add_argument("--poll-s", type=float, default=10.0)
     ap.add_argument("--block", type=int, default=DEFAULT_BLOCK, help="rows per /ingest POST")
     ap.add_argument("--once", action="store_true", help="one poll, then exit (smoke/test mode)")
+    ap.add_argument(
+        "--fanout", action="store_true",
+        help="--server is a fleet router: discover replicas via "
+        "/admin/replicas and ingest into every one",
+    )
     args = ap.parse_args()
     from moco_tpu.utils import retry
 
     seen: dict = {}
     while True:
-        poll_once(args.ckpt_dir, args.server, seen, args.block)
+        poll_once(args.ckpt_dir, args.server, seen, args.block, fanout=args.fanout)
         retries = retry.snapshot()
         if retries:
             # the per-site retry ledger (ingest.post + checkpoint-restore
