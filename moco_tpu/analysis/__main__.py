@@ -21,12 +21,14 @@ from __future__ import annotations
 import argparse
 import sys
 
+from moco_tpu.analysis.astutils import ModuleContext
 from moco_tpu.analysis.engine import (
     analyze_paths,
     discover_baseline,
     iter_rules,
     load_baseline,
     render_json,
+    render_sarif,
     render_text,
     write_baseline,
 )
@@ -53,6 +55,16 @@ def build_parser() -> argparse.ArgumentParser:
         help="include suppressed/baselined findings in text output",
     )
     p.add_argument("--list-rules", action="store_true")
+    p.add_argument(
+        "--sarif", default=None, metavar="FILE",
+        help="also write a SARIF 2.1.0 report to FILE (for GitHub code "
+        "scanning); the --format text/json report is unchanged",
+    )
+    p.add_argument(
+        "--dump-contracts", default=None, metavar="FILE",
+        help="also write the extracted cross-artifact contract registry "
+        "(metric keys, HTTP routes, fault sites, ...) as JSON to FILE",
+    )
     p.add_argument(
         "--baseline", default=None, metavar="FILE",
         help="findings baseline to accept (default: auto-discover "
@@ -156,6 +168,26 @@ def main(argv: list[str] | None = None) -> int:
             print(f"mocolint: cannot read baseline {baseline_path}: {e}", file=sys.stderr)
             return 2
     findings = analyze_paths(paths, rules=rules, baseline=baseline)
+    if args.sarif:
+        with open(args.sarif, "w", encoding="utf-8") as fh:
+            fh.write(render_sarif(findings) + "\n")
+    if args.dump_contracts:
+        import json
+
+        from moco_tpu.analysis import contracts as _contracts
+        from moco_tpu.analysis.engine import iter_python_files, parse_module
+
+        contexts = {}
+        for path in iter_python_files(paths):
+            with open(path, "r", encoding="utf-8") as fh:
+                ctx = parse_module(fh.read(), path)
+            if not isinstance(ctx, ModuleContext):
+                continue  # syntax errors already reported as findings
+            contexts[path] = ctx
+        registry = _contracts.build_registry(contexts)
+        with open(args.dump_contracts, "w", encoding="utf-8") as fh:
+            json.dump(registry.to_json(), fh, indent=2, sort_keys=True)
+            fh.write("\n")
     report = (
         render_json(findings)
         if args.format == "json"

@@ -382,3 +382,63 @@ def render_json(findings: list[Finding]) -> str:
         },
         indent=2,
     )
+
+
+def render_sarif(findings: list[Finding]) -> str:
+    """SARIF 2.1.0 — the GitHub code-scanning upload format. Suppressed
+    and baselined findings are included but carry a `suppressions`
+    entry, so code scanning shows them as dismissed rather than open."""
+    results = []
+    for f in findings:
+        result = {
+            "ruleId": f.rule,
+            "level": "warning",
+            "message": {"text": f.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {
+                            "uri": norm_path(f.path),
+                            "uriBaseId": "%SRCROOT%",
+                        },
+                        "region": {
+                            "startLine": f.line,
+                            "startColumn": f.col + 1,
+                        },
+                    }
+                }
+            ],
+        }
+        if f.suppressed:
+            result["suppressions"] = [
+                {"kind": "inSource", "justification": "mocolint: disable comment"}
+            ]
+        elif f.baselined:
+            result["suppressions"] = [
+                {"kind": "external", "justification": "mocolint-baseline.json"}
+            ]
+        results.append(result)
+    payload = {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "mocolint",
+                        "informationUri": "https://example.invalid/mocolint",
+                        "rules": [
+                            {
+                                "id": rule_id,
+                                "shortDescription": {"text": summary},
+                            }
+                            for rule_id, summary in iter_rules()
+                        ],
+                    }
+                },
+                "columnKind": "utf16CodeUnits",
+                "results": results,
+            }
+        ],
+    }
+    return json.dumps(payload, indent=2)

@@ -17,4 +17,8 @@ from moco_tpu.analysis.rules import (  # noqa: F401
     jx012_shared_state,
     jx013_lock_order,
     jx014_aot_freeze,
+    jx015_metric_schema,
+    jx016_http_protocol,
+    jx017_fault_sites,
+    jx018_exit_codes,
 )

@@ -251,7 +251,11 @@ PREFIX_VALIDATORS = {
     # sub-family (router client-observed + per-replica min/mean/max
     # aggregates) is never negative, like its serve/ twin
     "fleet_serve/": _num_or_null,
-    "fleet_serve/burn_rate_": _nonneg_or_null,
+    # the router renames each replica's serve/burn_rate_* gauges into
+    # this family dynamically ("fleet_serve/" + key.split("/", 1)[1]),
+    # so no literal emission exists for JX015 to see; the runtime
+    # contract-coverage gate proves the family live instead
+    "fleet_serve/burn_rate_": _nonneg_or_null,  # mocolint: disable=JX015
 }
 
 
@@ -266,6 +270,20 @@ def loads_strict(line: str) -> dict:
     if not isinstance(rec, dict):
         raise ValueError("metrics line is not a JSON object")
     return rec
+
+
+# Runtime contract-coverage arm (analysis/contracts.py): when a
+# callback is installed, every validator that actually applies to a
+# line — explicit field key or winning prefix family — is reported, so
+# a smoke leg can prove its metrics stream still exercises the schema
+# entries it claims to. None-checked per use: zero cost when off.
+_COVERAGE_CB = None
+
+
+def set_coverage_callback(cb) -> None:
+    """Install/clear the `cb(validator_key)` applied-validator callback."""
+    global _COVERAGE_CB
+    _COVERAGE_CB = cb
 
 
 def validate_line(rec: dict) -> list[str]:
@@ -284,8 +302,11 @@ def validate_line(rec: dict) -> list[str]:
         if missing:
             errors.append(f"training line missing {missing}")
     for k, check in FIELD_VALIDATORS.items():
-        if k in rec and not check(rec[k]):
-            errors.append(f"field {k!r} has invalid value {rec[k]!r}")
+        if k in rec:
+            if _COVERAGE_CB is not None:
+                _COVERAGE_CB(k)
+            if not check(rec[k]):
+                errors.append(f"field {k!r} has invalid value {rec[k]!r}")
     # prefix families (ema_drift/<group>, fleet/<field>_<stat>,
     # comms/<site>, alert/<rule>, serve/...) share per-family
     # validators. An explicit FIELD_VALIDATORS entry wins outright
@@ -298,8 +319,10 @@ def validate_line(rec: dict) -> list[str]:
             continue
         matches = [p for p in PREFIX_VALIDATORS if k.startswith(p)]
         if matches:
-            check = PREFIX_VALIDATORS[max(matches, key=len)]
-            if not check(v):
+            winner = max(matches, key=len)
+            if _COVERAGE_CB is not None:
+                _COVERAGE_CB(winner)
+            if not PREFIX_VALIDATORS[winner](v):
                 errors.append(f"field {k!r} has invalid value {v!r}")
     return errors
 

@@ -427,7 +427,10 @@ def derive_metrics_port(base_port: int, process_index: int) -> int:
 # How far the serve endpoint shifts off a colliding Prometheus port.
 # 16 is an upper bound on co-hosted processes per host, so the shifted
 # serve family can never land on ANY peer process's metrics port.
-SERVE_PORT_STRIDE = 16
+# Hosted by utils/contracts.py (single-source port rule, JX018) and
+# re-exported here for existing importers; the two functions around
+# this constant are the only sanctioned port-offset arithmetic.
+from moco_tpu.utils.contracts import SERVE_PORT_STRIDE  # noqa: F401
 
 
 def resolve_serve_port(serve_port: int, metrics_port: int = 0, process_index: int = 0) -> int:

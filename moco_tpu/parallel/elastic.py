@@ -54,9 +54,11 @@ from moco_tpu.utils.config import TrainConfig, apply_auto_scale
 
 # Exit code a multi-process survivor leaves with after the consensus +
 # emergency checkpoint (the launcher's signal to relaunch the surviving
-# hosts with the derived config). Distinct from the watchdog's 42 and
-# the kill fault's KILL_EXIT_CODE.
-RESCALE_EXIT_CODE = 75
+# hosts with the derived config). Distinct from the watchdog's stall
+# code and the kill fault's KILL_EXIT_CODE; hosted by
+# utils/contracts.py (single-source exit codes, JX018) and re-exported
+# here for existing importers.
+from moco_tpu.utils.contracts import RESCALE_EXIT_CODE  # noqa: F401
 
 
 @dataclasses.dataclass(frozen=True)

@@ -52,6 +52,7 @@ def main(argv=None) -> int:
 
     import os
 
+    from moco_tpu.analysis import contracts as contract_cov
     from moco_tpu.obs.sinks import JsonlSink
     from moco_tpu.serve.engine import InferenceEngine, load_serving_encoder
     from moco_tpu.serve.index import EmbeddingIndex
@@ -59,6 +60,11 @@ def main(argv=None) -> int:
     from moco_tpu.utils import faults
 
     faults.install_from_env()
+    # contract-coverage arm: MOCO_CONTRACT_COVERAGE=1 (planted by a
+    # smoke script before the supervisor spawns us) installs a recorder;
+    # the snapshot dumps on graceful exit below. A killed replica never
+    # dumps — its respawn covers the same contracts.
+    recorder = contract_cov.maybe_install_from_env()
     buckets = tuple(int(b) for b in args.buckets.split(","))
     module, params, stats, queue, queue_ptr, config = load_serving_encoder(
         args.ckpt_dir
@@ -106,6 +112,8 @@ def main(argv=None) -> int:
     server.close()
     if sink is not None:
         sink.close()
+    if recorder is not None and args.workdir:
+        recorder.dump(os.path.join(args.workdir, "contract_coverage.json"))
     print(
         f"replica {args.replica_index} drained "
         f"({'clean' if drained else 'timed out'}) and exited",

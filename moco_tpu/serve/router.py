@@ -68,6 +68,7 @@ from collections import Counter, deque
 from typing import Optional
 
 from moco_tpu.analysis import tsan
+from moco_tpu.analysis.contracts import record_route
 from moco_tpu.obs.slo import DEFAULT_WINDOWS, SLOBurnTracker
 from moco_tpu.utils import retry as retry_mod
 
@@ -373,6 +374,7 @@ class FleetRouter:
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (http.server API)
                 path = self.path.split("?")[0]
+                record_route("GET", path)
                 if path == "/healthz":
                     with server._fleet_lock:
                         healthy = sum(1 for r in server._replicas if r.admitted)
@@ -394,6 +396,7 @@ class FleetRouter:
             def do_POST(self):  # noqa: N802
                 t0 = time.perf_counter()
                 path, _, query = self.path.partition("?")
+                record_route("POST", path)
                 if path == "/admin/drain":
                     self._handle_admin_drain(query)
                     return

@@ -86,6 +86,7 @@ from moco_tpu.obs.sinks import resolve_serve_port  # noqa: F401  (public API)
 from moco_tpu.obs.slo import DEFAULT_WINDOWS, SLOBurnTracker, serve_alert_spec
 from moco_tpu.obs.trace import Tracer, get_tracer
 from moco_tpu.analysis import tsan
+from moco_tpu.analysis.contracts import record_route
 from moco_tpu.serve.batcher import BatcherClosedError, ContinuousBatcher, ServeMetrics
 from moco_tpu.serve.index import QUERY_MODES
 from moco_tpu.utils import faults
@@ -234,6 +235,7 @@ class ServeServer:
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (http.server API)
                 path = self.path.split("?")[0]
+                record_route("GET", path)
                 if path == "/healthz":
                     draining = server._draining.is_set()
                     self._json(200, {
@@ -261,6 +263,7 @@ class ServeServer:
             def do_POST(self):  # noqa: N802
                 t_arrival = time.perf_counter()
                 path, _, query = self.path.partition("?")
+                record_route("POST", path)
                 if path == "/ingest":
                     self._handle_ingest()
                     return

@@ -122,9 +122,11 @@ KINDS = (
 )
 
 # Exit code of a kill@host-faulted process in a real multi-process fleet
-# (distinct from the watchdog's 42): sudden death the survivors must
-# detect via heartbeat staleness, not a graceful shutdown.
-KILL_EXIT_CODE = 113
+# (distinct from the watchdog's stall code): sudden death the survivors
+# must detect via heartbeat staleness, not a graceful shutdown. Hosted
+# by utils/contracts.py (single-source exit codes, JX018) and
+# re-exported here for existing importers.
+from moco_tpu.utils.contracts import KILL_EXIT_CODE  # noqa: F401
 
 _INT_KEYS = ("step", "at", "times", "host", "replica")
 _FLOAT_KEYS = ("seconds", "ms")
@@ -396,34 +398,59 @@ def describe() -> list:
     return _PLAN.describe() if _PLAN else []
 
 
+# Runtime contract-coverage arm (analysis/contracts.py): when a
+# callback is installed, every hook invocation reports (kind, site) —
+# plan or no plan — so a smoke leg can prove each registered fault site
+# is still reachable. None-checked per call: zero cost when off.
+_COVERAGE_CB = None
+
+
+def set_coverage_callback(cb) -> None:
+    """Install/clear the `cb(kind, site)` hook-reached callback."""
+    global _COVERAGE_CB
+    _COVERAGE_CB = cb
+
+
 # thin delegating hooks — all no-ops when no plan is installed
 def maybe_io_error(site: str) -> None:
+    if _COVERAGE_CB is not None:
+        _COVERAGE_CB("io", site)
     if _PLAN is not None:
         _PLAN.maybe_io_error(site)
 
 
 def maybe_delay(site: str) -> None:
+    if _COVERAGE_CB is not None:
+        _COVERAGE_CB("delay", site)
     if _PLAN is not None:
         _PLAN.maybe_delay(site)
 
 
 def maybe_slow(site: str) -> None:
+    if _COVERAGE_CB is not None:
+        _COVERAGE_CB("slow", site)
     if _PLAN is not None:
         _PLAN.maybe_slow(site)
 
 
 def corrupt_loss(loss: float, step: int) -> float:
+    if _COVERAGE_CB is not None:
+        _COVERAGE_CB("nan", None)
     if _PLAN is not None:
         return _PLAN.corrupt_loss(loss, step)
     return loss
 
 
 def maybe_stall(step: int) -> None:
+    if _COVERAGE_CB is not None:
+        _COVERAGE_CB("stall", None)
     if _PLAN is not None:
         _PLAN.maybe_stall(step)
 
 
 def maybe_preempt(step: int) -> None:
+    if _COVERAGE_CB is not None:
+        _COVERAGE_CB("preempt", None)
     if _PLAN is not None:
         _PLAN.maybe_preempt(step)
 
@@ -431,11 +458,15 @@ def maybe_preempt(step: int) -> None:
 def maybe_kill_host(
     step: int, workdir: str, process_index: int, num_processes: int = 1
 ) -> None:
+    if _COVERAGE_CB is not None:
+        _COVERAGE_CB("kill", "host")
     if _PLAN is not None:
         _PLAN.maybe_kill_host(step, workdir, process_index, num_processes)
 
 
 def maybe_kill_replica(replica_index: int) -> None:
+    if _COVERAGE_CB is not None:
+        _COVERAGE_CB("kill", "replica")
     if _PLAN is not None:
         _PLAN.maybe_kill_replica(replica_index)
 
@@ -461,17 +492,23 @@ def strip_replica_kills(spec: Optional[str]) -> str:
 
 
 def diverge_marker(site: str) -> str:
+    if _COVERAGE_CB is not None:
+        _COVERAGE_CB("diverge", site)
     if _PLAN is not None:
         return _PLAN.diverge_marker(site)
     return ""
 
 
 def deadlock_marker(site: str) -> bool:
+    if _COVERAGE_CB is not None:
+        _COVERAGE_CB("deadlock", site)
     if _PLAN is not None:
         return _PLAN.deadlock_marker(site)
     return False
 
 
 def on_checkpoint_saved(directory: str, step: int, wait=None) -> None:
+    if _COVERAGE_CB is not None:
+        _COVERAGE_CB("ckpt_truncate", None)
     if _PLAN is not None:
         _PLAN.on_checkpoint_saved(directory, step, wait=wait)

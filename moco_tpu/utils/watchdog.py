@@ -29,7 +29,10 @@ import threading
 import time
 from typing import Callable, Optional
 
-STALL_EXIT_CODE = 42
+# Hosted by utils/contracts.py (single-source exit codes, JX018);
+# re-exported here so `from moco_tpu.utils.watchdog import
+# STALL_EXIT_CODE` keeps working.
+from moco_tpu.utils.contracts import STALL_EXIT_CODE  # noqa: F401
 
 
 class StepWatchdog:

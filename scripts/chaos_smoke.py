@@ -36,6 +36,8 @@ import tempfile
 # orchestrator and the re-invoked workers
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from moco_tpu.utils.contracts import STALL_EXIT_CODE  # noqa: E402
+
 EPOCHS = 3
 SPE = 2  # 32 synthetic examples / global batch 16
 
@@ -149,7 +151,8 @@ def orchestrate(base: str) -> None:
     # fire, far below the 120 s injected stall so the leg stays fast
     run_leg(
         "C1 stall+watchdog", c, EPOCHS,
-        faults="stall@step=3:seconds=120", watchdog=20.0, expect_rc=42,
+        faults="stall@step=3:seconds=120", watchdog=20.0,
+        expect_rc=STALL_EXIT_CODE,
     )
     steps_c1, _ = latest_step(c)
     check(steps_c1 is not None, "watchdog wrote an emergency checkpoint")

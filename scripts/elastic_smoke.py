@@ -226,6 +226,13 @@ def main() -> int:
         "(strict: an order cycle anywhere in the rescale storm aborts); "
         "asserts the clean lock_order.json artifact exists",
     )
+    ap.add_argument(
+        "--contract-coverage", action="store_true",
+        help="mocolint v4 runtime arm: record which fault hooks and "
+        "schema validators actually fire across both legs, write "
+        "contract_coverage.json, and FAIL if the kill@host hook or the "
+        "rescale/* validators never ran",
+    )
     args = ap.parse_args()
     base = args.workdir or tempfile.mkdtemp(prefix="elastic_smoke_")
     control_dir = os.path.join(base, "control")
@@ -233,9 +240,43 @@ def main() -> int:
     os.makedirs(control_dir, exist_ok=True)
     os.makedirs(chaos_dir, exist_ok=True)
 
+    recorder = None
+    if args.contract_coverage:
+        from moco_tpu.analysis import contracts as contract_cov
+
+        recorder = contract_cov.install_recorder()
+
     control = run_control(control_dir, sanitize_threads=args.sanitize_threads)
     chaos = run_chaos(chaos_dir, sanitize_threads=args.sanitize_threads)
+    # assert_surface re-validates metrics.jsonl — with the recorder
+    # wired into obs/schema that doubles as validator coverage
     summary = assert_surface(chaos_dir, chaos, control)
+
+    if recorder is not None:
+        cov = recorder.snapshot()
+        contract_cov.uninstall_recorder()
+        gate_faults = ["kill@host"]
+        gate_validators = ["rescale/dead_hosts", "rescale/new_num_data"]
+        missing = contract_cov.check_coverage(
+            cov, fault_sites=gate_faults, validators=gate_validators
+        )
+        with open(os.path.join(base, "contract_coverage.json"), "w") as f:
+            json.dump({
+                "coverage": cov,
+                "gates": {
+                    "fault_sites": gate_faults,
+                    "validators": gate_validators,
+                },
+                "missing": missing,
+            }, f, indent=2, sort_keys=True)
+        assert not missing, (
+            f"newly-dead contracts (registered but never fired): {missing}"
+        )
+        summary["contract_coverage"] = {
+            "fault_hooks": len(cov["fault_hooks"]),
+            "validators": len(cov["validators"]),
+            "missing": 0,
+        }
     if args.sanitize_threads:
         # the runs completed (no LockOrderError) AND left their reports:
         # the clean --sanitize-threads pass the CI leg asserts

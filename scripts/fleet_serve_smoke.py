@@ -91,20 +91,31 @@ def _get(url: str, timeout: float = 10.0) -> dict:
         return json.loads(r.read())
 
 
-def run_smoke(workdir: str) -> dict:
+def run_smoke(workdir: str, contract_coverage: bool = False) -> dict:
     import numpy as np
 
     import serve_smoke
+    from moco_tpu.analysis import contracts as contract_cov
     from moco_tpu.obs import schema
     from moco_tpu.obs.sinks import JsonlSink
     from moco_tpu.serve.fleet import ReplicaSupervisor
     from moco_tpu.serve.router import FleetRouter
+    from moco_tpu.utils import contracts as decl
     from moco_tpu.utils.faults import KILL_EXIT_CODE
 
     ckpt_dir = os.path.join(workdir, "toy_ckpt")
     serve_smoke.make_toy_checkpoint(ckpt_dir)
     rng = np.random.default_rng(0)
     warm_rows = rng.standard_normal((WARM_ROWS, 16)).astype(np.float32)
+
+    recorder = None
+    if contract_coverage:
+        # plant the env var BEFORE the supervisor spawns: replicas
+        # inherit it, install their own recorder, and dump
+        # replica<i>/contract_coverage.json on graceful exit; this
+        # (router) process records its own routes/validators directly
+        os.environ["MOCO_CONTRACT_COVERAGE"] = "1"
+        recorder = contract_cov.install_recorder()
 
     sup = ReplicaSupervisor(
         NUM_REPLICAS,
@@ -347,16 +358,88 @@ def run_smoke(workdir: str) -> dict:
             "drains": stats["fleet_serve/drains"],
             "requests_total": stats["fleet_serve/requests"],
         })
+
+        if contract_coverage:
+            # one-shot probes for the admin/debug routes the chaos story
+            # itself never needs — the coverage gate below demands EVERY
+            # declared route, not just the busy ones
+            _get(base + "/healthz")
+            _get(sup.url(0) + "/debug/flight")
+            req = urllib.request.Request(
+                base + f"/admin/undrain?replica={DRAINED_REPLICA}", data=b""
+            )
+            with urllib.request.urlopen(req, timeout=30) as r:
+                r.read()
+            # HTTP drain of a replica directly (the supervisor's own
+            # graceful path is SIGTERM): last thing before teardown
+            req = urllib.request.Request(
+                sup.url(SLOWED_REPLICA) + "/admin/drain", data=b""
+            )
+            with urllib.request.urlopen(req, timeout=60) as r:
+                r.read()
     finally:
         router.close()
         sup.close()
         sink.close()
+        if contract_coverage:
+            os.environ.pop("MOCO_CONTRACT_COVERAGE", None)
         with open(os.path.join(workdir, "supervisor_events.json"), "w") as f:
             json.dump(sup.events(), f, indent=2)
 
     # flushed fleet_serve/* lines must be schema-strict
     problems = schema.validate_file(os.path.join(workdir, "metrics.jsonl"))
     assert not problems, f"router metrics schema violations: {problems[:5]}"
+
+    if recorder is not None:
+        # validate each replica's serve/* stream too — with the recorder
+        # still wired into obs/schema this doubles as validator coverage
+        for i in range(NUM_REPLICAS):
+            rp = os.path.join(workdir, f"replica{i}", "metrics.jsonl")
+            if os.path.exists(rp):
+                rproblems = schema.validate_file(rp)
+                assert not rproblems, (
+                    f"replica {i} metrics schema violations: {rproblems[:5]}"
+                )
+        snaps = [recorder.snapshot()]
+        for i in range(NUM_REPLICAS):
+            p = os.path.join(workdir, f"replica{i}", "contract_coverage.json")
+            if os.path.exists(p):
+                with open(p) as fh:
+                    snaps.append(json.load(fh))
+        contract_cov.uninstall_recorder()
+        cov = contract_cov.merge_coverage(snaps)
+        gate_routes = list(dict.fromkeys(
+            contract_cov.declared_route_gates("replica")
+            + contract_cov.declared_route_gates("router")
+        ))
+        gate_faults = [f"slow@{s}" for s in decl.SERVE_STAGE_SITES] + [
+            "kill@replica"
+        ]
+        missing = contract_cov.check_coverage(
+            cov,
+            routes=gate_routes,
+            fault_sites=gate_faults,
+            validators=decl.SERVE_GATED_VALIDATORS,
+        )
+        with open(os.path.join(workdir, "contract_coverage.json"), "w") as f:
+            json.dump({
+                "coverage": cov,
+                "gates": {
+                    "routes": gate_routes,
+                    "fault_sites": gate_faults,
+                    "validators": list(decl.SERVE_GATED_VALIDATORS),
+                },
+                "missing": missing,
+            }, f, indent=2, sort_keys=True)
+        assert not missing, (
+            f"newly-dead contracts (registered but never fired): {missing}"
+        )
+        summary["contract_coverage"] = {
+            "routes": len(cov["routes"]),
+            "fault_hooks": len(cov["fault_hooks"]),
+            "validators": len(cov["validators"]),
+            "missing": 0,
+        }
 
     # the threaded fleet modules must LINT clean, not just run clean
     # (JX011 join discipline, JX012 shared-state, JX013 lock ordering)
@@ -387,10 +470,17 @@ def main() -> int:
     pin_platform_from_env()
     ap = argparse.ArgumentParser(description="serving-fleet router chaos smoke")
     ap.add_argument("--workdir", default=None)
+    ap.add_argument(
+        "--contract-coverage", action="store_true",
+        help="mocolint v4 runtime arm: record which declared routes, "
+        "fault sites, and schema validators actually fire (router + "
+        "every replica process), merge into contract_coverage.json, and "
+        "FAIL on any registered contract that never fired",
+    )
     args = ap.parse_args()
     workdir = args.workdir or tempfile.mkdtemp(prefix="fleet_serve_smoke_")
     os.makedirs(workdir, exist_ok=True)
-    summary = run_smoke(workdir)
+    summary = run_smoke(workdir, contract_coverage=args.contract_coverage)
     print("\n== fleet serve smoke PASS ==")
     for k, v in summary.items():
         print(f"  {k}: {v}")

@@ -299,14 +299,48 @@ def check(ledger_path: str, input_path: str, threshold: float | None = None) -> 
     return rc
 
 
+def _all_legs_skipped(entry: dict) -> bool:
+    legs = entry.get("legs")
+    if not isinstance(legs, dict) or not legs:
+        return False
+    return all(isinstance(l, dict) and not l.get("ran") for l in legs.values())
+
+
+def tracked_series(entries: list[dict]) -> dict:
+    """metric -> (run_id, value, unit): the latest REAL (non-None) point
+    per tracked series — the training headline plus the serving and
+    ann_ab sub-records. A round whose legs all hit the skip ledger
+    appends None values; the series keeps its last measured point."""
+    latest: dict = {}
+    for e in entries:
+        for sub in (e, e.get("serving") or {}, e.get("ann_ab") or {}):
+            metric, value = sub.get("metric"), sub.get("value")
+            if metric and value is not None:
+                latest[metric] = (e.get("run_id", "?"), value, sub.get("unit"))
+    return latest
+
+
 def show(ledger_path: str) -> int:
     ledger = load_ledger(ledger_path)
-    for e in ledger["entries"]:
+    entries = ledger["entries"]
+    for e in entries:
+        tag = "  (all legs skipped)" if _all_legs_skipped(e) else ""
         print(
             f"{e.get('run_id', '?'):>6}  {e.get('platform', '?'):>4}  "
-            f"{e.get('value')}  {e.get('metric')}"
+            f"{e.get('value')}  {e.get('metric')}{tag}"
         )
-    print(f"{len(ledger['entries'])} entries in {os.path.abspath(ledger_path)}")
+    # Without this block a tail of skip-only rounds makes the whole
+    # trajectory read empty even though every series has data a round or
+    # two back — `show` must always answer "where does each series
+    # stand" from the latest real point.
+    latest = tracked_series(entries)
+    if latest:
+        print("tracked series (latest real point):")
+        for metric in sorted(latest):
+            run_id, value, unit = latest[metric]
+            suffix = f" {unit}" if unit else ""
+            print(f"  {metric} = {value}{suffix}  (run {run_id})")
+    print(f"{len(entries)} entries in {os.path.abspath(ledger_path)}")
     return 0
 
 

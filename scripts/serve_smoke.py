@@ -169,7 +169,11 @@ def make_toy_checkpoint(workdir: str):
     return config
 
 
-def run_smoke(workdir: str, sanitize_threads: bool = False) -> dict:
+def run_smoke(
+    workdir: str,
+    sanitize_threads: bool = False,
+    contract_coverage: bool = False,
+) -> dict:
     """Boot → fire → tear down; returns the summary dict (also written
     to workdir/serve_smoke.json). Split from the assertions so tests
     can reuse the run.
@@ -185,10 +189,13 @@ def run_smoke(workdir: str, sanitize_threads: bool = False) -> dict:
     """
     import numpy as np
 
+    from moco_tpu.analysis import contracts as contract_cov
+    from moco_tpu.obs import schema
     from moco_tpu.obs.sinks import JsonlSink
     from moco_tpu.serve.engine import InferenceEngine, load_serving_encoder
     from moco_tpu.serve.index import EmbeddingIndex
     from moco_tpu.serve.server import ServeServer
+    from moco_tpu.utils import contracts as decl
 
     tsan_sanitizer = None
     if sanitize_threads:
@@ -197,6 +204,10 @@ def run_smoke(workdir: str, sanitize_threads: bool = False) -> dict:
         tsan_sanitizer = ThreadSanitizer(
             workdir=workdir, strict=False, profile=False
         )
+
+    recorder = None
+    if contract_coverage:
+        recorder = contract_cov.install_recorder()
 
     ckpt_dir = os.path.join(workdir, "toy_ckpt")
     make_toy_checkpoint(ckpt_dir)
@@ -273,6 +284,18 @@ def run_smoke(workdir: str, sanitize_threads: bool = False) -> dict:
     ingest_summary = _ingest_leg(ckpt_dir, server, index)
 
     stats_out = server.stats()
+
+    if contract_coverage:
+        # one-shot probes: the health/stats/drain routes the load legs
+        # never touch, so the coverage gate can demand every declared
+        # replica route (drain last — the server is done serving here)
+        for probe in ("/healthz", "/stats"):
+            with urllib.request.urlopen(base + probe, timeout=30) as r:
+                r.read()
+        drain_req = urllib.request.Request(base + "/admin/drain", data=b"")
+        with urllib.request.urlopen(drain_req, timeout=60) as r:
+            r.read()
+
     server.close()
 
     # -- leg 6: the IVF retrieval tier ----------------------------------
@@ -299,8 +322,47 @@ def run_smoke(workdir: str, sanitize_threads: bool = False) -> dict:
         tsan_summary["chaos"] = _tsan_chaos_leg(engine, index, workdir)
 
     sink.close()
+
+    contract_summary = None
+    if recorder is not None:
+        # re-validating the flushed stream with the recorder still wired
+        # into obs/schema records validator coverage (assert_serve_surface
+        # re-checks the same file later for correctness)
+        problems = schema.validate_file(os.path.join(workdir, "metrics.jsonl"))
+        assert not problems, f"metrics schema violations: {problems[:5]}"
+        cov = recorder.snapshot()
+        contract_cov.uninstall_recorder()
+        missing = contract_cov.check_coverage(
+            cov,
+            routes=contract_cov.declared_route_gates("replica"),
+            fault_sites=[f"slow@{s}" for s in decl.SERVE_STAGE_SITES],
+            validators=decl.SERVE_GATED_VALIDATORS,
+        )
+        with open(os.path.join(workdir, "contract_coverage.json"), "w") as f:
+            json.dump({
+                "coverage": cov,
+                "gates": {
+                    "routes": contract_cov.declared_route_gates("replica"),
+                    "fault_sites": [
+                        f"slow@{s}" for s in decl.SERVE_STAGE_SITES
+                    ],
+                    "validators": list(decl.SERVE_GATED_VALIDATORS),
+                },
+                "missing": missing,
+            }, f, indent=2, sort_keys=True)
+        assert not missing, (
+            f"newly-dead contracts (registered but never fired): {missing}"
+        )
+        contract_summary = {
+            "routes": len(cov["routes"]),
+            "fault_hooks": len(cov["fault_hooks"]),
+            "validators": len(cov["validators"]),
+            "missing": 0,
+        }
+
     summary = {
         "tsan": tsan_summary,
+        "contract_coverage": contract_summary,
         "requests_sent": per_client * NUM_CLIENTS,
         "failures": failures,
         "smoke_slo_ms": SMOKE_SLO_MS,
@@ -848,10 +910,21 @@ def main() -> int:
         "a deadlock@site=serve.metrics chaos leg (lock_order_diff.json "
         "with both stacks uploads as a CI artifact)",
     )
+    ap.add_argument(
+        "--contract-coverage", action="store_true",
+        help="mocolint v4 runtime arm: record which declared routes, "
+        "fault sites, and schema validators actually fire during the "
+        "run, write contract_coverage.json, and FAIL on any registered "
+        "contract that never fired",
+    )
     args = ap.parse_args()
     workdir = args.workdir or tempfile.mkdtemp(prefix="serve_smoke_")
     os.makedirs(workdir, exist_ok=True)
-    summary = run_smoke(workdir, sanitize_threads=args.sanitize_threads)
+    summary = run_smoke(
+        workdir,
+        sanitize_threads=args.sanitize_threads,
+        contract_coverage=args.contract_coverage,
+    )
     assert_serve_surface(workdir, summary)
     s = summary["stats"]
     iv = summary["ivf"]["stats"]

@@ -1,0 +1,32 @@
+"""Known-bad: metric-schema drift (JX015).
+
+The module defines its own validator tables (standing in for
+obs/schema.py), emits one key nothing validates, one family head
+nothing validates, and carries one dead field validator plus one prefix
+family that can never be the longest match for anything.
+"""
+
+
+def _num(v):
+    return isinstance(v, (int, float))
+
+
+FIELD_VALIDATORS = {
+    "train/loss": _num,
+    "train/abandoned_gauge": _num,  # expect: JX015
+}
+
+PREFIX_VALIDATORS = {
+    "train/": _num,
+    "serve/trace_": _num,  # expect: JX015
+}
+
+
+def flush(sink, loss, group, lr, stage, ms):
+    payload = {
+        "train/loss": loss,
+        "queue/depth": 3,  # expect: JX015
+    }
+    payload[f"train/lr_{group}"] = lr
+    payload[f"debug/{stage}_ms"] = ms  # expect: JX015
+    sink.write(payload)

@@ -1,0 +1,22 @@
+"""Known-good twin of jx015_bad: every emission is covered by a field
+validator or a prefix family, every validator is live, and the prefix
+family wins the longest match for a real emission."""
+
+
+def _num(v):
+    return isinstance(v, (int, float))
+
+
+FIELD_VALIDATORS = {
+    "train/loss": _num,
+}
+
+PREFIX_VALIDATORS = {
+    "train/": _num,
+}
+
+
+def flush(sink, loss, group, lr):
+    payload = {"train/loss": loss}
+    payload[f"train/lr_{group}"] = lr
+    sink.write(payload)

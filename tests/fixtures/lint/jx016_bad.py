@@ -1,0 +1,37 @@
+"""Known-bad: HTTP-protocol drift from the declared registry (JX016).
+
+A handler serving a route the registry never declared (and /ingest
+under the wrong method), a client calling a typo'd route, a POST to
+/ingest without its required X-Rows-Shape header, and a retry wrapper
+whose guard admits the non-idempotent /ingest route.
+"""
+
+import urllib.request
+
+
+class Handler:
+    def do_GET(self):
+        path = self.path.split("?")[0]
+        if path == "/admin/reboot":  # expect: JX016
+            self._json(200, {})
+        elif path == "/ingest":  # expect: JX016
+            self._json(200, {})
+
+    def _json(self, code, obj):
+        pass
+
+
+def probe(base):
+    req = urllib.request.Request(base + "/statz")  # expect: JX016
+    return urllib.request.urlopen(req)
+
+
+def ingest(base, rows):
+    req = urllib.request.Request(base + "/ingest", data=rows.tobytes())  # expect: JX016
+    return urllib.request.urlopen(req)
+
+
+def forward(retry_call, path, body):
+    if path not in ("/embed", "/ingest"):
+        return None
+    return retry_call(lambda: body)  # expect: JX016

@@ -1,0 +1,42 @@
+"""Known-good twin of jx016_bad: declared routes under their declared
+methods, required headers read on the handler side and sent on the
+client side, and the retry guard admitting only idempotent routes."""
+
+import urllib.request
+
+
+class Handler:
+    def do_GET(self):
+        path = self.path.split("?")[0]
+        if path == "/healthz":
+            self._json(200, {"ok": True})
+
+    def do_POST(self):
+        path = self.path.split("?")[0]
+        if path == "/ingest":
+            shape = self.headers.get("X-Rows-Shape", "")
+            self._json(200, {"shape": shape})
+
+    def _json(self, code, obj):
+        pass
+
+
+def probe(base):
+    with urllib.request.urlopen(base + "/healthz", timeout=5.0) as r:
+        return r.read()
+
+
+def ingest(base, rows):
+    req = urllib.request.Request(
+        base + "/ingest",
+        data=rows.tobytes(),
+        headers={"X-Rows-Shape": ",".join(str(s) for s in rows.shape)},
+    )
+    with urllib.request.urlopen(req, timeout=5.0) as r:
+        return r.read()
+
+
+def forward(retry_call, path, body):
+    if path not in ("/embed", "/neighbors"):
+        return None
+    return retry_call(lambda: body)

@@ -1,0 +1,17 @@
+"""Known-bad: fault-grammar site drift (JX017).
+
+A chaos spec naming a site no hook can fire (the stage was renamed and
+the spec literal never followed), and a hook call whose site is missing
+from the declared FAULT_SITES vocabulary.
+"""
+
+from moco_tpu.utils import faults
+
+
+def chaos_leg(install):
+    install("slow@site=serve.engine_exec:ms=250")  # expect: JX017
+
+
+def handle(batch):
+    faults.maybe_slow("serve.bogus_stage")  # expect: JX017
+    return batch

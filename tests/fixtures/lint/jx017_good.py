@@ -1,0 +1,13 @@
+"""Known-good twin of jx017_bad: the spec names a registered stage site
+and the hook site is in the declared FAULT_SITES vocabulary."""
+
+from moco_tpu.utils import faults
+
+
+def chaos_leg(install):
+    install("slow@site=serve.engine_execute:ms=250")
+
+
+def handle(batch):
+    faults.maybe_slow("serve.engine_execute")
+    return batch

@@ -1,0 +1,28 @@
+"""Known-bad: re-typed exit codes and hand-computed port offsets
+(JX018). Every magic number here exists as a shared constant in
+utils/contracts.py; every offset has a sanctioned resolver in
+obs/sinks.py.
+"""
+
+import os
+
+SERVE_PORT_STRIDE = 16
+
+
+def watchdog_fire():
+    os._exit(42)  # expect: JX018
+
+
+def harness(run):
+    proc = run(expect_rc=75)  # expect: JX018
+    if proc.returncode == 113:  # expect: JX018
+        return "killed"
+    return "ok"
+
+
+def metrics_port_for(port, process_index):
+    return port + process_index  # expect: JX018
+
+
+def serve_port_for(port):
+    return port + SERVE_PORT_STRIDE  # expect: JX018

@@ -1,0 +1,26 @@
+"""Known-good twin of jx018_bad: exit codes come from the shared
+constants module and port offsets from the sanctioned resolver."""
+
+import os
+
+from moco_tpu.obs.sinks import derive_metrics_port
+from moco_tpu.utils.contracts import (
+    KILL_EXIT_CODE,
+    RESCALE_EXIT_CODE,
+    STALL_EXIT_CODE,
+)
+
+
+def watchdog_fire():
+    os._exit(STALL_EXIT_CODE)
+
+
+def harness(run):
+    proc = run(expect_rc=RESCALE_EXIT_CODE)
+    if proc.returncode == KILL_EXIT_CODE:
+        return "killed"
+    return "ok"
+
+
+def metrics_port_for(port, process_index):
+    return derive_metrics_port(port, process_index)

@@ -26,6 +26,7 @@ FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures", 
 ALL_RULES = (
     "JX001", "JX002", "JX003", "JX004", "JX005", "JX006", "JX007",
     "JX008", "JX009", "JX010", "JX011", "JX012", "JX013", "JX014",
+    "JX015", "JX016", "JX017", "JX018",
 )
 
 _EXPECT_RE = re.compile(r"#\s*expect:\s*([A-Z0-9,\s]+)")

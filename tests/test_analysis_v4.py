@@ -1,0 +1,387 @@
+"""mocolint v4: cross-artifact contract analysis (JX015-JX018).
+
+Covers the contract registry's extraction (metric emissions/validator
+tables, handler + client routes, fault hook sites and spec literals),
+the declared registry in utils/contracts.py, the runtime
+contract-coverage recorder (callbacks into obs/schema + utils/faults,
+merge, the newly-dead-contract gate), the SARIF/--dump-contracts CLI
+arms, and — via literal `slow@site=` specs — that every registered
+serve stage's fault hook is actually exercised (what JX017 clause 3
+counts as coverage).
+"""
+
+import json
+import os
+
+import pytest
+
+from moco_tpu.analysis import contracts
+from moco_tpu.analysis.__main__ import main as mocolint_main
+from moco_tpu.analysis.engine import analyze_paths, parse_module, render_sarif
+from moco_tpu.obs import schema
+from moco_tpu.utils import contracts as decl
+from moco_tpu.utils import faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures", "lint")
+
+
+def _registry(src: str, path: str = "m/mod.py"):
+    ctx = parse_module(src, path)
+    assert hasattr(ctx, "tree"), f"parse failed: {ctx}"
+    return contracts.build_registry({path: ctx})
+
+
+# ---------------------------------------------------------------------------
+# the declared registry (utils/contracts.py)
+
+
+def test_declared_registry_shape():
+    assert decl.EXIT_CODES == {"stall": 42, "rescale": 75, "kill": 113}
+    assert decl.SERVE_PORT_STRIDE == 16
+    # /ingest appends rows: a retried ingest double-writes, so it MUST
+    # stay outside the idempotent set the router may retry/hedge
+    assert decl.ROUTES["/ingest"].idempotent is False
+    assert "/ingest" not in decl.IDEMPOTENT_ROUTES
+    assert "/embed" in decl.IDEMPOTENT_ROUTES
+    assert decl.ROUTES["/embed"].headers == ("X-Image-Shape",)
+    assert decl.ROUTES["/ingest"].headers == ("X-Rows-Shape",)
+    assert decl.ROUTES["/healthz"].methods == ("GET",)
+    for site in decl.SERVE_STAGE_SITES:
+        assert site in decl.FAULT_SITES["slow"]
+
+
+def test_declared_route_gates_server_scope():
+    every = contracts.declared_route_gates()
+    replica = contracts.declared_route_gates("replica")
+    router = contracts.declared_route_gates("router")
+    assert "POST /ingest" in replica and "POST /ingest" not in router
+    assert "POST /admin/undrain" in router and "POST /admin/undrain" not in replica
+    assert "GET /healthz" in replica and "GET /healthz" in router
+    # the Prometheus scrape endpoint belongs to neither serve surface
+    assert "GET /metrics" in every
+    assert "GET /metrics" not in replica and "GET /metrics" not in router
+    assert set(replica) | set(router) <= set(every)
+
+
+# ---------------------------------------------------------------------------
+# fault-spec parsing
+
+
+def test_parse_fault_specs():
+    specs = contracts.parse_fault_specs(
+        "slow@site=serve.ingress:ms=5,kill@replica=1:at=5 then io@site=data.read:at=2"
+    )
+    assert [s["kind"] for s in specs] == ["slow", "kill", "io"]
+    assert specs[0]["params"] == {"site": "serve.ingress", "ms": "5"}
+    assert specs[1]["params"] == {"replica": "1", "at": "5"}
+    assert specs[2]["params"]["site"] == "data.read"
+
+
+def test_parse_fault_specs_fstring_placeholder_site_is_dynamic():
+    import ast
+
+    node = ast.parse('f"slow@site={site}:ms=3"').body[0].value
+    (spec,) = contracts.parse_fault_specs(contracts._joined_literal(node))
+    assert spec["kind"] == "slow"
+    assert spec["params"]["site"] is None  # dynamic — unverifiable
+
+
+# ---------------------------------------------------------------------------
+# static registry extraction
+
+
+def test_registry_extracts_metric_emissions_and_validators():
+    reg = _registry(
+        "FIELD_VALIDATORS = {'train/loss': None}\n"
+        "PREFIX_VALIDATORS = {'train/': None}\n"
+        "def flush(sink, group, lr):\n"
+        "    payload = {'queue/depth': 3}\n"
+        "    payload[f'train/lr_{group}'] = lr\n"
+        "    sink.write(payload)\n"
+    )
+    assert reg.validator_keys() == {"train/loss"}
+    assert reg.validator_prefixes() == {"train/"}
+    # validator-table dict keys are NOT emissions
+    assert {e.key for e in reg.emitted_keys} == {"queue/depth"}
+    assert {e.prefix for e in reg.emitted_prefixes} == {"train/lr_"}
+
+
+def test_registry_extracts_handler_and_client_sides():
+    reg = _registry(
+        "import urllib.request\n"
+        "class H:\n"
+        "    def do_GET(self):\n"
+        "        if self.path.split('?')[0] == '/healthz':\n"
+        "            self.send_response(200)\n"
+        "    def do_POST(self):\n"
+        "        if self.path in ('/embed', '/neighbors'):\n"
+        "            shape = self.headers.get('X-Image-Shape')\n"
+        "def probe(base):\n"
+        "    return urllib.request.urlopen(base + '/stats', timeout=5)\n"
+        "def push(base, body):\n"
+        "    return urllib.request.Request(\n"
+        "        'http://127.0.0.1:8000/ingest?block=1', data=body)\n"
+    )
+    handled = {(h.route, h.method) for h in reg.handler_routes}
+    assert handled == {
+        ("/healthz", "GET"), ("/embed", "POST"), ("/neighbors", "POST")
+    }
+    assert "X-Image-Shape" in reg.class_headers["m/mod.py::H"]
+    calls = {(c.route, c.method) for c in reg.client_calls}
+    # full URLs reduce to the path, query strings are stripped, and a
+    # non-None data= flips the inferred method to POST
+    assert calls == {("/stats", "GET"), ("/ingest", "POST")}
+    assert [s.code for s in reg.handler_status] == [200]
+
+
+def test_registry_extracts_hooks_retry_guards_and_specs():
+    reg = _registry(
+        "from moco_tpu.utils import faults\n"
+        "SITE = 'serve.scatter'\n"
+        "def go(retry_call, path, batch):\n"
+        "    faults.maybe_slow(SITE)\n"
+        "    faults.maybe_delay('data.read')\n"
+        "    if path not in ('/embed', '/neighbors'):\n"
+        "        return None\n"
+        "    return retry_call(lambda: batch)\n"
+        "CHAOS = 'slow@site=serve.scatter:ms=9'\n"
+    )
+    # literal args AND module-level string constants resolve
+    assert reg.hook_site_set("slow") == {"serve.scatter"}
+    assert reg.hook_site_set("delay") == {"data.read"}
+    (wrap,) = reg.retry_wraps
+    assert set(wrap.routes) == {"/embed", "/neighbors"}
+    (spec,) = reg.spec_literals
+    assert spec.kind == "slow" and spec.params["site"] == "serve.scatter"
+
+
+def test_registry_for_caches_on_program():
+    path = os.path.join(FIXTURES, "jx017_good.py")
+    findings = analyze_paths([path], rules=["JX017"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# runtime recorder
+
+
+def test_recorder_counts_normalize_and_merge():
+    rec = contracts.ContractCoverageRecorder()
+    rec.record_route("post", "/embed?k=3")
+    rec.record_route("POST", "/embed")
+    rec.record_validator("serve/p99_ms")
+    rec.record_fault_hook("slow", "serve.ingress")
+    rec.record_fault_hook("kill", None)
+    snap = rec.snapshot()
+    assert snap["routes"] == {"POST /embed": 2}
+    assert snap["fault_hooks"] == {"slow@serve.ingress": 1, "kill": 1}
+    merged = contracts.merge_coverage(
+        [snap, {"routes": {"POST /embed": 1, "GET /stats": 4}}]
+    )
+    assert merged["routes"] == {"POST /embed": 3, "GET /stats": 4}
+    assert merged["fault_hooks"]["slow@serve.ingress"] == 1
+
+
+def test_recorder_dump_roundtrip(tmp_path):
+    rec = contracts.ContractCoverageRecorder()
+    rec.record_route("GET", "/healthz")
+    out = tmp_path / "contract_coverage.json"
+    dumped = rec.dump(str(out))
+    assert json.loads(out.read_text()) == dumped
+
+
+def test_check_coverage_flags_seeded_dead_contract():
+    """The CI gate's core: a registered contract nothing fired is named
+    in the missing list — here /debug/flight is deliberately dead."""
+    rec = contracts.ContractCoverageRecorder()
+    rec.record_route("GET", "/healthz")
+    for site in decl.SERVE_STAGE_SITES:
+        rec.record_fault_hook("slow", site)
+    missing = contracts.check_coverage(
+        rec.snapshot(),
+        routes=["GET /healthz", "GET /debug/flight"],
+        fault_sites=[f"slow@{s}" for s in decl.SERVE_STAGE_SITES],
+        validators=[],
+    )
+    assert missing == ["route never handled: GET /debug/flight"]
+
+
+def test_install_recorder_wires_schema_and_faults_callbacks():
+    rec = contracts.install_recorder()
+    try:
+        line = {
+            "step": 1,
+            "time": 0.0,
+            "rescale/dead_hosts": [3],
+            "serve/burn_rate_60s": 0.25,
+        }
+        assert schema.validate_line(line) == []
+        faults.maybe_slow("serve.ingress")  # no plan installed: still recorded
+        snap = rec.snapshot()
+        assert snap["validators"]["rescale/dead_hosts"] == 1
+        # the WINNING (longest-match) prefix family is recorded, not the
+        # generic serve/ fallback
+        assert snap["validators"]["serve/burn_rate_"] == 1
+        assert "serve/" not in snap["validators"]
+        assert snap["fault_hooks"]["slow@serve.ingress"] == 1
+    finally:
+        contracts.uninstall_recorder()
+    assert contracts.get_recorder() is None
+
+
+def test_record_route_is_noop_without_recorder():
+    contracts.record_route("GET", "/healthz")  # must not raise
+    assert contracts.get_recorder() is None
+
+
+def test_maybe_install_from_env(monkeypatch):
+    monkeypatch.delenv("MOCO_CONTRACT_COVERAGE", raising=False)
+    assert contracts.maybe_install_from_env() is None
+    monkeypatch.setenv("MOCO_CONTRACT_COVERAGE", "1")
+    rec = contracts.maybe_install_from_env()
+    try:
+        assert rec is not None and contracts.get_recorder() is rec
+    finally:
+        contracts.uninstall_recorder()
+
+
+# ---------------------------------------------------------------------------
+# every registered serve stage's slow hook is exercised (JX017 clause 3
+# counts exactly these literal spec strings as coverage — keep them
+# literal, an f-string site would parse as dynamic)
+
+SLOW_SITE_SPECS = (
+    "slow@site=serve.ingress:ms=1",
+    "slow@site=serve.batch_assemble:ms=1",
+    "slow@site=serve.engine_execute:ms=1",
+    "slow@site=serve.index_query:ms=1",
+    "slow@site=serve.scatter:ms=1",
+    "slow@site=serve.respond:ms=1",
+)
+
+
+@pytest.mark.parametrize("spec", SLOW_SITE_SPECS)
+def test_registered_slow_site_spec_fires_its_hook(spec, monkeypatch):
+    site = spec.split("site=")[1].split(":")[0]
+    assert site in decl.SERVE_STAGE_SITES
+    slept = []
+    monkeypatch.setattr(faults.time, "sleep", lambda s: slept.append(s))
+    faults.install(spec)
+    try:
+        faults.maybe_slow(site)
+    finally:
+        faults.clear()
+    assert slept == [0.001]
+
+
+def test_slow_spec_on_other_site_is_a_noop(monkeypatch):
+    slept = []
+    monkeypatch.setattr(faults.time, "sleep", lambda s: slept.append(s))
+    faults.install("slow@site=serve.ingress:ms=1")
+    try:
+        faults.maybe_slow("serve.respond")
+    finally:
+        faults.clear()
+    assert slept == []
+
+
+# ---------------------------------------------------------------------------
+# coverage callbacks fire plan-or-no-plan
+
+
+def test_faults_coverage_callback_fires_without_plan():
+    seen = []
+    faults.set_coverage_callback(lambda kind, site: seen.append((kind, site)))
+    try:
+        faults.clear()
+        faults.maybe_delay("data.read")
+        faults.maybe_io_error("data.read")
+        faults.maybe_slow("serve.engine_execute")
+    finally:
+        faults.set_coverage_callback(None)
+    assert ("delay", "data.read") in seen
+    assert ("io", "data.read") in seen
+    assert ("slow", "serve.engine_execute") in seen
+
+
+# ---------------------------------------------------------------------------
+# CLI arms: SARIF + contract dump + partial-tree stability
+
+
+def test_render_sarif_structure():
+    path = os.path.join(FIXTURES, "jx018_bad.py")
+    findings = analyze_paths([path], rules=["JX018"])
+    assert findings
+    doc = json.loads(render_sarif(findings))
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"JX015", "JX016", "JX017", "JX018"} <= rule_ids
+    assert run["results"] and all(
+        r["ruleId"] == "JX018" for r in run["results"]
+    )
+    loc = run["results"][0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("jx018_bad.py")
+    assert loc["region"]["startLine"] > 0
+    assert "suppressions" not in run["results"][0]  # active finding
+
+
+def test_render_sarif_marks_suppressed_and_baselined():
+    import dataclasses
+
+    from moco_tpu.analysis.engine import Finding
+
+    doc = json.loads(render_sarif([
+        Finding("JX018", "m", "a.py", 3, suppressed=True),
+        Finding("JX018", "m", "b.py", 4, baselined=True),
+    ]))
+    results = doc["runs"][0]["results"]
+    assert results[0]["suppressions"][0]["kind"] == "inSource"
+    assert results[1]["suppressions"][0]["kind"] == "external"
+    assert dataclasses.is_dataclass(Finding)
+
+
+def test_cli_sarif_flag(tmp_path, capsys):
+    out = tmp_path / "mocolint.sarif"
+    rc = mocolint_main([
+        os.path.join(FIXTURES, "jx016_bad.py"),
+        "--no-baseline", "--rules", "JX016", "--sarif", str(out),
+    ])
+    capsys.readouterr()
+    assert rc == 1
+    doc = json.loads(out.read_text())
+    assert doc["runs"][0]["results"]
+
+
+def test_cli_dump_contracts(tmp_path, capsys):
+    out = tmp_path / "contracts.json"
+    rc = mocolint_main([
+        os.path.join(REPO, "moco_tpu", "serve", "server.py"),
+        "--no-baseline", "--rules", "JX018", "--dump-contracts", str(out),
+    ])
+    capsys.readouterr()
+    assert rc == 0
+    dumped = json.loads(out.read_text())
+    handled = {(h["route"], h["method"]) for h in dumped["handler_routes"]}
+    assert ("/embed", "POST") in handled and ("/healthz", "GET") in handled
+    assert {h["site"] for h in dumped["hook_sites"] if h["kind"] == "slow"} == {
+        "serve.ingress", "serve.respond",
+    }
+
+
+def test_partial_tree_lint_is_quiet_on_fleet_subset(capsys):
+    """The fleet smoke lints a 5-file subset with --no-baseline: the v4
+    rules must validate against the DECLARED registry there and stay
+    quiet (whole-tree-only clauses gated off), or the smoke's lint gate
+    would false-positive on every partial run."""
+    rc = mocolint_main([
+        os.path.join(REPO, "moco_tpu", "serve", "router.py"),
+        os.path.join(REPO, "moco_tpu", "serve", "fleet.py"),
+        os.path.join(REPO, "moco_tpu", "serve", "replica_main.py"),
+        os.path.join(REPO, "moco_tpu", "serve", "batcher.py"),
+        os.path.join(REPO, "scripts", "fleet_serve_smoke.py"),
+        "--no-baseline",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0, out

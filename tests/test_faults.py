@@ -12,7 +12,7 @@ import time
 import pytest
 
 from moco_tpu.utils import faults, retry
-from moco_tpu.utils.watchdog import StepWatchdog
+from moco_tpu.utils.watchdog import STALL_EXIT_CODE, StepWatchdog
 
 
 @pytest.fixture(autouse=True)
@@ -208,7 +208,7 @@ def test_watchdog_fires_dumps_and_exits(tmp_path):
     time.sleep(0.6)  # no beats: must fire
     wd.stop()
     assert events.get("stall") is True
-    assert events.get("exit") == 42
+    assert events.get("exit") == STALL_EXIT_CODE
     assert "Thread" in dump.read_text()  # all-thread stack dump landed
 
 
@@ -239,7 +239,7 @@ def test_watchdog_startup_grace_covers_compilation():
     wd.beat()
     time.sleep(0.4)  # past timeout with beats seen: fires
     wd.stop()
-    assert fired == [42]
+    assert fired == [STALL_EXIT_CODE]
 
 
 def test_watchdog_on_stall_exception_does_not_block_exit():
@@ -256,4 +256,4 @@ def test_watchdog_on_stall_exception_does_not_block_exit():
     wd.start()
     time.sleep(0.4)
     wd.stop()
-    assert events == ["stall", 42]
+    assert events == ["stall", STALL_EXIT_CODE]

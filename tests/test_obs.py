@@ -265,7 +265,10 @@ def test_prometheus_sink_serves_text_format():
             assert resp.headers["Content-Type"].startswith("text/plain")
         assert served == body
         with pytest.raises(urllib.error.HTTPError):
-            urllib.request.urlopen(f"http://127.0.0.1:{s.port}/other", timeout=5)
+            # deliberately-undeclared route: asserts the 404 path
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{s.port}/other", timeout=5
+            )  # mocolint: disable=JX016
     finally:
         s.close()
 

@@ -160,3 +160,64 @@ def test_quant_tier_gates(tmp_path):
     assert ledger_mod.check(ledger, _write(tmp_path / "q4.json", accel)) == 1
     accel["serving"]["quant"]["w8a8"]["qps"] = 12.0  # beats w8
     assert ledger_mod.check(ledger, _write(tmp_path / "q5.json", accel)) == 0
+
+
+# -- show: tracked-series summary survives skip-only rounds ----------------
+
+
+def test_show_summarizes_series_despite_skip_only_tail(tmp_path, capsys):
+    """Regression: a latest round whose legs all hit the skip ledger
+    (value None across the board) must not make `show` read empty — the
+    summary block reports the latest REAL point per tracked series."""
+    ledger = str(tmp_path / "ledger.json")
+    full = {
+        "metric": "moco_v1_r18_cpu_smoke_imgs_per_sec",
+        "value": 9.5,
+        "unit": "imgs/sec/chip",
+        "serving": {
+            "metric": "moco_serve_resnet18_cpu_smoke_queries_per_sec",
+            "value": 8.2,
+            "unit": "queries/sec",
+        },
+        "ann_ab": {
+            "metric": "moco_ann_ivf_cpu_smoke_queries_per_sec",
+            "value": 310.0,
+        },
+        "legs": {"serving": {"ran": True, "skip_reason": None}},
+    }
+    skip_only = {
+        "metric": "moco_v1_r18_cpu_smoke_imgs_per_sec",
+        "value": None,
+        "serving": {"metric": "moco_serve_resnet18_cpu_smoke_queries_per_sec", "value": None},
+        "ann_ab": {"metric": "moco_ann_ivf_cpu_smoke_queries_per_sec", "value": None},
+        "legs": {
+            "accelerator": {"ran": False, "skip_reason": "pinned cpu"},
+            "serving": {"ran": False, "skip_reason": "BENCH_SKIP_SERVE set"},
+        },
+    }
+    ledger_mod.append(ledger, _write(tmp_path / "s1.json", full), "r20")
+    ledger_mod.append(ledger, _write(tmp_path / "s2.json", skip_only), "r21")
+    assert ledger_mod.show(ledger) == 0
+    out = capsys.readouterr().out
+    assert "(all legs skipped)" in out
+    assert "tracked series (latest real point):" in out
+    assert "moco_v1_r18_cpu_smoke_imgs_per_sec = 9.5 imgs/sec/chip  (run r20)" in out
+    assert "moco_serve_resnet18_cpu_smoke_queries_per_sec = 8.2" in out
+    assert "moco_ann_ivf_cpu_smoke_queries_per_sec = 310.0  (run r20)" in out
+
+
+def test_show_on_tracked_seed_ledger(capsys):
+    """The in-repo ledger itself: every series the repo has measured
+    shows a latest real point (this is the 'trajectory reads empty'
+    bug's acceptance check against real data)."""
+    path = os.path.join(os.path.dirname(__file__), "..", "PERF_LEDGER.json")
+    assert ledger_mod.show(path) == 0
+    out = capsys.readouterr().out
+    assert "tracked series (latest real point):" in out
+    for series in (
+        "moco_v1_r18_cpu_smoke_imgs_per_sec",
+        "moco_v2_r50_pretrain_imgs_per_sec_per_chip",
+        "moco_serve_resnet18_cpu_smoke_queries_per_sec",
+        "moco_ann_ivf_cpu_smoke_queries_per_sec",
+    ):
+        assert f"{series} = " in out

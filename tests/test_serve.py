@@ -529,8 +529,10 @@ def test_resolve_serve_port_offset_rule():
     assert resolve_serve_port(8000, 0, 0) == 8000
     assert resolve_serve_port(8000, 0, 3) == 8003
     # collision with the Prometheus family -> shift by the stride
-    assert resolve_serve_port(9090, 9090, 0) == 9090 + SERVE_PORT_STRIDE
-    assert resolve_serve_port(9090, 9090, 2) == 9092 + SERVE_PORT_STRIDE
+    # this test ASSERTS the offset rule, so it hand-computes the
+    # expected values on purpose
+    assert resolve_serve_port(9090, 9090, 0) == 9090 + SERVE_PORT_STRIDE  # mocolint: disable=JX018
+    assert resolve_serve_port(9090, 9090, 2) == 9092 + SERVE_PORT_STRIDE  # mocolint: disable=JX018
     # distinct families never shift
     assert resolve_serve_port(8000, 9090, 1) == 8001
     # 0 = ephemeral stays 0
