@@ -597,6 +597,101 @@ def main() -> None:
             qps_traced, payload_traced = measure(reqtrace=True)
             trace_overhead_pct = (qps_chip - qps_traced) / qps_chip * 100.0
 
+            # ---- router tracing A/B (ISSUE 18) --------------------------
+            # The distributed-tracing cost at the fleet front door: the
+            # same warm engine behind ONE HTTP replica, a FleetRouter in
+            # front, closed-loop clients through real sockets; tracing
+            # OFF vs ON (context injection, per-attempt spans, the
+            # stitcher, the flight ring). perf_ledger gates the delta
+            # under the same trace-overhead caps as the replica-side A/B.
+            router_qps = router_qps_traced = None
+            if not os.environ.get("BENCH_SKIP_ROUTER"):
+                import urllib.request as _urlreq
+
+                from moco_tpu.serve.router import FleetRouter
+                from moco_tpu.serve.server import ServeServer
+
+                replica = ServeServer(
+                    eng, index=index, port=0, slo_ms=slo_ms,
+                    neighbors_k=5, warmup=False,
+                )
+                router_meas = float(os.environ.get(
+                    "BENCH_ROUTER_MEASURE_S", max(measure_s / 2, 2.0)
+                ))
+
+                def router_pass(rt: bool) -> float:
+                    router = FleetRouter(
+                        replica_urls=[f"http://127.0.0.1:{replica.port}"],
+                        port=0, slo_ms=slo_ms, hedge=False, reqtrace=rt,
+                    )
+                    rbase = f"http://127.0.0.1:{router.port}"
+                    measuring = threading.Event()
+                    stop_r = threading.Event()
+                    rcounts = [0] * 4
+
+                    def rclient(ci: int) -> None:
+                        crng = np.random.default_rng(200 + ci)
+                        while not stop_r.is_set():
+                            n = int(crng.choice(sizes))
+                            req = _urlreq.Request(
+                                rbase + "/embed",
+                                data=canned[n].tobytes(),
+                                headers={"X-Image-Shape": ",".join(
+                                    map(str, canned[n].shape)
+                                )},
+                            )
+                            try:
+                                with _urlreq.urlopen(req, timeout=30) as r:
+                                    r.read()
+                            except Exception:
+                                if measuring.is_set():
+                                    return
+                                # pre-measure 503s while the health loop
+                                # admits the replica are expected
+                                time.sleep(0.05)
+                                continue
+                            if measuring.is_set():
+                                rcounts[ci] += 1
+
+                    try:
+                        rclients = [
+                            threading.Thread(
+                                target=rclient, args=(i,), daemon=True
+                            )
+                            for i in range(len(rcounts))
+                        ]
+                        for c in rclients:
+                            c.start()
+                        time.sleep(max(warm_s, 1.0))
+                        measuring.set()
+                        t0r = time.perf_counter()
+                        time.sleep(router_meas)
+                        measuring.clear()
+                        dtr = time.perf_counter() - t0r
+                        stop_r.set()
+                        for c in rclients:
+                            c.join(timeout=10.0)
+                    finally:
+                        router.close()
+                    completed = sum(rcounts)
+                    if completed == 0:
+                        raise RuntimeError(
+                            f"no request completed inside the router "
+                            f"{router_meas}s measure window (reqtrace={rt})"
+                        )
+                    return completed / dtr / n_dev
+
+                try:
+                    router_qps = router_pass(False)
+                    router_qps_traced = router_pass(True)
+                finally:
+                    replica.close()
+            router_trace_overhead_pct = (
+                (router_qps - router_qps_traced) / router_qps * 100.0
+                if router_qps
+                else None
+            )
+
             # ---- quantized-engine A/B (ISSUE 11): w8 vs w8a8 ----------
             # Same params, same buckets, same index; qps measured in
             # short INTERLEAVED slices (the tiers alternate inside one
@@ -733,6 +828,23 @@ def main() -> None:
                 # stage split
                 "qps_traced": round(qps_traced, 2),
                 "trace_overhead_pct": round(trace_overhead_pct, 2),
+                # distributed-tracing A/B at the fleet front door
+                # (ISSUE 18): qps through a FleetRouter + one HTTP
+                # replica with router tracing OFF vs ON; the overhead is
+                # gated by perf_ledger.py check under the same caps
+                "router_qps": (
+                    round(router_qps, 2) if router_qps is not None else None
+                ),
+                "router_qps_traced": (
+                    round(router_qps_traced, 2)
+                    if router_qps_traced is not None
+                    else None
+                ),
+                "router_trace_overhead_pct": (
+                    round(router_trace_overhead_pct, 2)
+                    if router_trace_overhead_pct is not None
+                    else None
+                ),
                 "trace_stage_ms": {
                     k[len("serve/trace_"):-len("_ms")]: v
                     for k, v in payload_traced.items()
@@ -754,6 +866,13 @@ def main() -> None:
                 f"overhead={trace_overhead_pct:+.1f}%)",
                 file=sys.stderr,
             )
+            if router_trace_overhead_pct is not None:
+                print(
+                    f"router tracing A/B: {router_qps:.1f} q/s untraced, "
+                    f"{router_qps_traced:.1f} q/s traced "
+                    f"(overhead={router_trace_overhead_pct:+.1f}%)",
+                    file=sys.stderr,
+                )
         except Exception as e:
             serving = None  # never ship a half-built serving record
             legs["serving"]["ran"] = False

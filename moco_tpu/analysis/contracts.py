@@ -510,7 +510,8 @@ class ContractCoverageRecorder:
     """Thread-safe counters for contracts observed at runtime.
 
     Sections: `validators` (schema keys/prefixes that applied), `routes`
-    ("METHOD /path" handled), `fault_hooks` ("kind@site" hook reached).
+    ("METHOD /path" handled), `fault_hooks` ("kind@site" hook reached),
+    `headers` (propagated trace headers parsed/injected — obs/ctxprop).
     Multi-process runs dump per-process files and merge with
     `merge_coverage`."""
 
@@ -519,6 +520,7 @@ class ContractCoverageRecorder:
         self.validators: dict[str, int] = {}
         self.routes: dict[str, int] = {}
         self.fault_hooks: dict[str, int] = {}
+        self.headers: dict[str, int] = {}
 
     def _bump(self, table: dict, key: str) -> None:
         with self._lock:
@@ -533,12 +535,16 @@ class ContractCoverageRecorder:
     def record_fault_hook(self, kind: str, site: Optional[str]) -> None:
         self._bump(self.fault_hooks, f"{kind}@{site}" if site else kind)
 
+    def record_header(self, name: str) -> None:
+        self._bump(self.headers, name)
+
     def snapshot(self) -> dict:
         with self._lock:
             return {
                 "validators": dict(self.validators),
                 "routes": dict(self.routes),
                 "fault_hooks": dict(self.fault_hooks),
+                "headers": dict(self.headers),
             }
 
     def dump(self, path: str) -> dict:
@@ -558,22 +564,26 @@ def install_recorder(
     """Install (and wire into obs/schema + utils/faults) a recorder."""
     global _RECORDER
     _RECORDER = rec or ContractCoverageRecorder()
+    from moco_tpu.obs import ctxprop as _ctxprop
     from moco_tpu.obs import schema as _schema
     from moco_tpu.utils import faults as _faults
 
     _schema.set_coverage_callback(_RECORDER.record_validator)
     _faults.set_coverage_callback(_RECORDER.record_fault_hook)
+    _ctxprop.set_coverage_callback(_RECORDER.record_header)
     return _RECORDER
 
 
 def uninstall_recorder() -> None:
     global _RECORDER
     _RECORDER = None
+    from moco_tpu.obs import ctxprop as _ctxprop
     from moco_tpu.obs import schema as _schema
     from moco_tpu.utils import faults as _faults
 
     _schema.set_coverage_callback(None)
     _faults.set_coverage_callback(None)
+    _ctxprop.set_coverage_callback(None)
 
 
 def get_recorder() -> Optional[ContractCoverageRecorder]:
@@ -599,7 +609,7 @@ def maybe_install_from_env() -> Optional[ContractCoverageRecorder]:
 
 def merge_coverage(snapshots: Iterable[dict]) -> dict:
     """Union per-process coverage dumps (counts added)."""
-    out: dict = {"validators": {}, "routes": {}, "fault_hooks": {}}
+    out: dict = {"validators": {}, "routes": {}, "fault_hooks": {}, "headers": {}}
     for snap in snapshots:
         for section in out:
             for k, v in (snap.get(section) or {}).items():
@@ -612,11 +622,13 @@ def check_coverage(
     routes: Iterable[str] = (),
     fault_sites: Iterable[str] = (),
     validators: Iterable[str] = (),
+    headers: Iterable[str] = (),
 ) -> list[str]:
     """Missing-contract descriptions (empty list = gate passes).
 
     `routes` entries are "METHOD /path"; `fault_sites` are "kind@site"
-    (or a bare kind); `validators` are schema keys/prefixes."""
+    (or a bare kind); `validators` are schema keys/prefixes; `headers`
+    are propagated trace-header names (obs/ctxprop)."""
     missing = []
     seen_routes = set(coverage.get("routes") or {})
     for r in routes:
@@ -630,6 +642,10 @@ def check_coverage(
     for v in validators:
         if v not in seen_validators:
             missing.append(f"schema validator never applied: {v}")
+    seen_headers = set(coverage.get("headers") or {})
+    for h in headers:
+        if h not in seen_headers:
+            missing.append(f"trace header never propagated: {h}")
     return missing
 
 

@@ -78,6 +78,16 @@ def check_http_protocol(ctx):
                     f"handler for {h.route!r} never reads required header "
                     f"{header!r} declared in the registry",
                 )
+        # propagated headers (opt_headers) bind the HANDLER side only:
+        # a plain client may omit X-Trace-Id, but every server of the
+        # route must adopt it or the trace silently breaks at this hop.
+        for header in r.opt_headers:
+            if header not in hdrs:
+                yield (
+                    h.line,
+                    f"handler for {h.route!r} never reads propagated header "
+                    f"{header!r} declared in the registry (opt_headers)",
+                )
 
     for c in reg.client_calls:
         if c.path != ctx.path:

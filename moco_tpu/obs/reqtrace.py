@@ -24,6 +24,14 @@ multi-replica Perfetto timeline and the flight-recorder dumps stay
 attributable once N processes serve behind a balancer — the
 precondition the ROADMAP's multi-replica item names.
 
+A request arriving through the fleet front door additionally carries a
+propagated trace context (obs/ctxprop.py): the router's 128-bit trace
+id plus the span id of the dispatch attempt that sent it. Adopting that
+context (``new_trace(ctx=...)``) makes this replica's stage waterfall a
+CHILD of the router's attempt span — the ids ride the waterfall dict
+and the emitted `request` span, which is what the offline stitcher
+(scripts/trace_merge.py) and the router's in-band stitching join on.
+
 Stage semantics (batcher-granularity stages are shared by every rider
 of a micro-batch — the per-request part is queue_wait):
 
@@ -76,10 +84,14 @@ class RequestTrace:
     plain list. Everything else (waterfall dict, stage sums, span
     records) runs off-path."""
 
-    __slots__ = ("req_id", "replica", "rows", "t0", "wall_t0", "stages")
+    __slots__ = (
+        "req_id", "replica", "rows", "t0", "wall_t0", "stages",
+        "trace_id", "parent_span", "span_id",
+    )
 
     def __init__(
-        self, req_id: str, rows: int = 1, replica: int = 0, t0: float = None
+        self, req_id: str, rows: int = 1, replica: int = 0, t0: float = None,
+        ctx=None,
     ):
         self.req_id = req_id
         self.replica = int(replica)
@@ -91,6 +103,15 @@ class RequestTrace:
         self.t0 = now if t0 is None else float(t0)
         self.wall_t0 = time.time() - (now - self.t0)
         self.stages: list[tuple[str, float, float]] = []
+        # adopted distributed-trace identity (obs/ctxprop.TraceContext);
+        # absent for requests that arrive without the fleet front door
+        self.trace_id = ctx.trace_id if ctx is not None else None
+        self.parent_span = ctx.span_id if ctx is not None else None
+        self.span_id = None
+        if ctx is not None:
+            from moco_tpu.obs import ctxprop
+
+            self.span_id = ctxprop.new_span_id()
 
     def stamp(self, stage: str, t0: float, t1: float) -> None:
         """Record one completed stage interval (perf_counter domain)."""
@@ -115,8 +136,9 @@ class RequestTrace:
     def waterfall(self) -> dict:
         """JSON-ready waterfall record — the flight recorder's unit of
         storage and the dump/report format. Stage starts are ms relative
-        to ingress."""
-        return {
+        to ingress. Requests carrying an adopted trace context include
+        the distributed-trace ids — the join keys for stitching."""
+        out = {
             "request_id": self.req_id,
             "replica": self.replica,
             "rows": self.rows,
@@ -131,6 +153,12 @@ class RequestTrace:
                 for stage, t0, t1 in self.stages
             ],
         }
+        if self.trace_id is not None:
+            out["trace_id"] = self.trace_id
+            out["span_id"] = self.span_id
+            if self.parent_span is not None:
+                out["parent_span"] = self.parent_span
+        return out
 
 
 class RequestIdAllocator:
@@ -142,12 +170,13 @@ class RequestIdAllocator:
         self.replica = int(replica)
         self._seq = itertools.count()
 
-    def new_trace(self, rows: int = 1, t0: float = None) -> RequestTrace:
+    def new_trace(self, rows: int = 1, t0: float = None, ctx=None) -> RequestTrace:
         return RequestTrace(
             f"r{self.replica}-{next(self._seq):06d}",
             rows=rows,
             replica=self.replica,
             t0=t0,
+            ctx=ctx,
         )
 
 
@@ -162,6 +191,12 @@ def emit_request_spans(tracer, trace: RequestTrace, lane: int) -> None:
     tid = REQUEST_LANE_TID_BASE + lane
     thread = f"requests-{lane}"
     t_end = max(t1 for _, _, t1 in trace.stages)
+    ids = {}
+    if trace.trace_id is not None:
+        ids["trace_id"] = trace.trace_id
+        ids["span_id"] = trace.span_id
+        if trace.parent_span is not None:
+            ids["parent_span"] = trace.parent_span
     tracer.emit_span(
         "request",
         trace.t0,
@@ -171,6 +206,7 @@ def emit_request_spans(tracer, trace: RequestTrace, lane: int) -> None:
         request_id=trace.req_id,
         rows=trace.rows,
         replica=trace.replica,
+        **ids,
     )
     for stage, t0, t1 in trace.stages:
         tracer.emit_span(

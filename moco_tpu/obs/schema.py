@@ -218,6 +218,9 @@ FIELD_VALIDATORS = {
     "fleet_serve/replicas": lambda v: _int_like(v) and v >= 1,
     "fleet_serve/replicas_healthy": lambda v: _int_like(v) and v >= 0,
     "fleet_serve/slo_objective": lambda v: _num(v) and 0.0 < v < 1.0,
+    # cumulative cost of cancelled hedge lanes (serve/router.py hedge-
+    # loser accounting) — a counter in ms, never negative
+    "fleet_serve/hedge_wasted_ms": _nonneg_or_null,
     # alert event lines (obs/alerts.py)
     "alert": lambda v: isinstance(v, str),
     "severity": lambda v: v in ("warn", "fatal"),
@@ -256,6 +259,10 @@ PREFIX_VALIDATORS = {
     # so no literal emission exists for JX015 to see; the runtime
     # contract-coverage gate proves the family live instead
     "fleet_serve/burn_rate_": _nonneg_or_null,  # mocolint: disable=JX015
+    # critical-path hop attribution (obs/critpath.py metrics_payload):
+    # mean ms on the request critical path per hop — never negative,
+    # null while the aggregation window is empty
+    "fleet_serve/critpath_": _nonneg_or_null,
 }
 
 

@@ -117,26 +117,30 @@ def serve_alert_spec(
     windows: Sequence[int] = DEFAULT_WINDOWS,
     fast_burn: float = DEFAULT_FAST_BURN,
     slow_burn: float = DEFAULT_SLOW_BURN,
+    prefix: str = "serve",
 ) -> str:
     """The serving default alert rules, in the obs/alerts.py grammar —
     threshold rules over the burn-rate gauges (fast window at
     `fast_burn`, slow window at `slow_burn`) plus, when `slo_ms` is
     given, a p99-over-SLO warn. `ServeServer(alert_spec="serve_default")`
     expands through this with its own slo/window settings; smokes pass
-    tightened values so a short run can fire."""
+    tightened values so a short run can fire. The router expands with
+    `prefix="fleet_serve"` so its rules watch the client-observed
+    fleet gauges rather than any single replica's."""
     windows = tuple(sorted(int(w) for w in windows))
     rules = [
-        f"threshold@name=slo_burn_fast:field=serve/burn_rate_{windows[0]}s:"
+        f"threshold@name=slo_burn_fast:field={prefix}/burn_rate_{windows[0]}s:"
         f"value={fast_burn:g}"
     ]
     if len(windows) > 1:
         rules.append(
-            f"threshold@name=slo_burn_slow:field=serve/burn_rate_{windows[-1]}s:"
+            f"threshold@name=slo_burn_slow:field={prefix}/burn_rate_{windows[-1]}s:"
             f"value={slow_burn:g}"
         )
     if slo_ms:
         rules.append(
-            f"threshold@name=slo_p99_over:field=serve/p99_ms:value={float(slo_ms):g}"
+            f"threshold@name=slo_p99_over:field={prefix}/p99_ms:"
+            f"value={float(slo_ms):g}"
         )
     return ",".join(rules)
 

@@ -35,7 +35,8 @@ gains a `"replica": i` field next to the replica-scoped `request_id`,
 so a flight-recorder dump blames the right process), `GET /healthz`,
 `GET /stats` (the `fleet_serve/*` gauge line), `GET /admin/replicas`
 (fleet topology — `scripts/serve_ingest.py --fanout` discovers the
-replica URLs here), `POST /admin/drain?replica=i[&restart=0]`,
+replica URLs here), `GET /debug/flight` (the fleet flight ring),
+`POST /admin/drain?replica=i[&restart=0]`,
 `POST /admin/undrain?replica=i`.
 
 Observability rides the PR 10 rails: the router's own client-observed
@@ -44,6 +45,24 @@ acceptance gauge), and each replica's `serve/burn_rate_<w>s` gauges are
 aggregated min/mean/max (the `obs/fleet.py` pattern) alongside
 `fleet_serve/replicas_healthy`, per-replica dispatch counts, and the
 hedge/retry/shed/breaker counters.
+
+**Distributed tracing** (the fleet's request-level answer): every
+proxied request gets a `RouterRequestTrace` — ingress/admission/respond
+stamps plus one record per dispatch ATTEMPT (replica, retry round,
+primary/hedge lane, breaker state at acquisition, outcome). Each
+attempt mints a span id and propagates `X-Trace-Id`/`X-Parent-Span`
+(obs/ctxprop.py) to the replica, whose stage waterfall comes BACK
+in-band as the response's `trace` block — so the router holds the
+complete multi-hop picture without an offline merge: network send/recv
+split around the replica's own total, every failed attempt, and the
+hedge loser's cancelled lane (its cost lands in
+`fleet_serve/hedge_wasted_ms`, never in the latency histogram). The
+stitched trace feeds three consumers: a fleet-level FlightRecorder
+(dumped at the burn-alert edge and on `GET /debug/flight`), the
+obs/critpath.py analyzer backing the `fleet_serve/critpath_<hop>_ms`
+gauge family, and — when a workdir is given — a per-router Perfetto
+stream (`trace_events.r<i>.jsonl` + `heartbeat.r<i>.json` anchor) that
+scripts/trace_merge.py joins with the replica streams by trace id.
 
 Threading (JX011/JX012/JX013 discipline): ONE fleet lock
 (`router.fleet`, tsan factory) guards every replica handle and breaker
@@ -58,8 +77,11 @@ from __future__ import annotations
 
 import concurrent.futures
 import http.server
+import itertools
 import json
+import os
 import queue
+import socket
 import threading
 import time
 import urllib.error
@@ -69,7 +91,12 @@ from typing import Optional
 
 from moco_tpu.analysis import tsan
 from moco_tpu.analysis.contracts import record_route
-from moco_tpu.obs.slo import DEFAULT_WINDOWS, SLOBurnTracker
+from moco_tpu.obs import critpath, ctxprop
+from moco_tpu.obs.alerts import AlertEngine, parse_rules
+from moco_tpu.obs.flight import FlightRecorder
+from moco_tpu.obs.reqtrace import REQUEST_LANE_TID_BASE, REQUEST_LANES
+from moco_tpu.obs.slo import DEFAULT_WINDOWS, SLOBurnTracker, serve_alert_spec
+from moco_tpu.obs.trace import Tracer
 from moco_tpu.utils import retry as retry_mod
 
 BREAKER_CLOSED = "closed"
@@ -210,6 +237,215 @@ class ReplicaHandle:
         }
 
 
+class RouterRequestTrace:
+    """One proxied request's distributed trace, router side: the
+    ingress/admission/respond stamps plus a record per dispatch attempt
+    (obs/critpath.py stitched schema is `stitched()`'s output).
+
+    Threading: the handler thread creates the trace and its attempt
+    records; each attempt is FINALIZED on the dispatch-pool thread that
+    ran it (`outcome` is written last, so any reader seeing a non-
+    "pending" outcome sees a complete record); the router's flusher
+    reads completed traces. Same GIL-atomic append/assign discipline as
+    obs/reqtrace.py — no per-request lock."""
+
+    __slots__ = (
+        "trace_id", "span_id", "parent_span", "path", "t0", "wall_t0",
+        "ingress_ms", "admission_ms", "respond_ms", "status",
+        "request_id", "t_end", "attempts", "_round",
+    )
+
+    def __init__(self, path: str, t0: float, ctx=None):
+        now = time.perf_counter()
+        self.t0 = float(t0)
+        self.wall_t0 = time.time() - (now - self.t0)
+        self.path = path
+        # adopt a client-carried trace id (an upstream gateway);
+        # otherwise the router is the trace root and mints one
+        self.trace_id = ctx.trace_id if ctx is not None else ctxprop.new_trace_id()
+        self.parent_span = ctx.span_id if ctx is not None else None
+        self.span_id = ctxprop.new_span_id()
+        self.ingress_ms = None
+        self.admission_ms = None
+        self.respond_ms = None
+        self.status = None
+        self.request_id = None
+        self.t_end = None
+        self.attempts: list[dict] = []
+        self._round = 0
+
+    def next_round(self) -> int:
+        """The retry-round index for the next `_attempt_hedged` call —
+        handler-thread only (retry rounds are sequential)."""
+        rnd = self._round
+        self._round += 1
+        return rnd
+
+    def new_attempt(self, replica: int, retry_index: int, lane: str,
+                    breaker: str) -> dict:
+        att = {
+            "trace_id": self.trace_id,
+            "span_id": ctxprop.new_span_id(),
+            "replica": int(replica),
+            "retry_index": int(retry_index),
+            "lane": lane,  # "primary" | "hedge"
+            "breaker": breaker,  # breaker state at acquisition
+            "origin_t0": self.t0,  # perf_counter origin for start_ms
+            "t0": None, "t1": None,  # perf_counter, set by the dispatcher
+            "start_ms": None, "dur_ms": None,
+            "net_send_ms": None, "net_recv_ms": None,
+            "wasted_ms": None,  # a discarded hedge lane's cost
+            "winner": False,
+            "remote": None,  # the replica's in-band stage waterfall
+            "error": None,
+            "outcome": "pending",  # -> ok | failed | cancelled; set LAST
+        }
+        self.attempts.append(att)
+        return att
+
+    def done(self, status: int, request_id=None) -> None:
+        self.t_end = time.perf_counter()
+        self.status = int(status)
+        self.request_id = request_id
+
+    def complete(self) -> bool:
+        """Every attempt finalized (a hedge loser may still be in
+        flight after the client got its answer)."""
+        return all(a["outcome"] != "pending" for a in self.attempts)
+
+    def total_ms(self) -> float:
+        end = self.t_end if self.t_end is not None else time.perf_counter()
+        return (end - self.t0) * 1e3
+
+    def stitched(self) -> dict:
+        """The obs/critpath.py stitched-trace record (private perf-
+        counter fields stripped)."""
+        attempts = []
+        for a in self.attempts:
+            pub = {k: v for k, v in a.items()
+                   if k not in ("origin_t0", "t0", "t1")}
+            attempts.append(pub)
+        return {
+            "trace_id": self.trace_id,
+            "request_id": self.request_id,
+            "path": self.path,
+            "status": self.status,
+            "wall_t0": self.wall_t0,
+            "total_ms": round(self.total_ms(), 3),
+            "router": {
+                "ingress_ms": self.ingress_ms,
+                "admission_ms": self.admission_ms,
+                "respond_ms": self.respond_ms,
+            },
+            "attempts": attempts,
+        }
+
+
+def _emit_router_spans(tracer, rtrace: RouterRequestTrace, lane: int) -> None:
+    """Render one completed router trace onto the Perfetto stream: a
+    `request` parent, the router stage children, and one
+    `router/attempt` span per dispatch lane (with its net send/recv
+    split when the replica's waterfall came back). Runs on the flusher
+    thread; the `request` lanes round-robin like obs/reqtrace.py."""
+    if tracer is None:
+        return
+    lane = lane % REQUEST_LANES
+    tid = REQUEST_LANE_TID_BASE + lane
+    thread = f"requests-{lane}"
+    t_end = rtrace.t_end if rtrace.t_end is not None else time.perf_counter()
+    tracer.emit_span(
+        "request",
+        rtrace.t0,
+        t_end,
+        tid=tid,
+        thread=thread,
+        trace_id=rtrace.trace_id,
+        span_id=rtrace.span_id,
+        path=rtrace.path,
+        status=rtrace.status,
+        request_id=rtrace.request_id,
+    )
+    cursor = rtrace.t0
+    for name, ms in (("router/ingress", rtrace.ingress_ms),
+                     ("router/admission", rtrace.admission_ms)):
+        if ms is None:
+            continue
+        tracer.emit_span(name, cursor, cursor + ms / 1e3, tid=tid,
+                         thread=thread, trace_id=rtrace.trace_id)
+        cursor += ms / 1e3
+    for att in rtrace.attempts:
+        if att["t0"] is None:
+            continue
+        t1 = att["t1"] if att["t1"] is not None else t_end
+        tracer.emit_span(
+            "router/attempt",
+            att["t0"],
+            t1,
+            tid=tid,
+            thread=thread,
+            trace_id=rtrace.trace_id,
+            span_id=att["span_id"],
+            replica=att["replica"],
+            retry_index=att["retry_index"],
+            lane=att["lane"],
+            breaker=att["breaker"],
+            outcome=att["outcome"],
+            winner=att["winner"],
+            wasted_ms=att["wasted_ms"],
+            error=att["error"],
+        )
+        if att["net_send_ms"] is not None:
+            tracer.emit_span(
+                "router/net_send", att["t0"],
+                att["t0"] + att["net_send_ms"] / 1e3,
+                tid=tid, thread=thread, trace_id=rtrace.trace_id,
+            )
+        if att["net_recv_ms"] is not None and att["t1"] is not None:
+            tracer.emit_span(
+                "router/net_recv", att["t1"] - att["net_recv_ms"] / 1e3,
+                att["t1"],
+                tid=tid, thread=thread, trace_id=rtrace.trace_id,
+            )
+    if rtrace.respond_ms is not None:
+        tracer.emit_span(
+            "router/respond", t_end - rtrace.respond_ms / 1e3, t_end,
+            tid=tid, thread=thread, trace_id=rtrace.trace_id,
+        )
+
+
+def _finalize_attempt(
+    attempt: Optional[dict], outcome: str, error: Optional[str] = None,
+    remote: Optional[dict] = None, t_wall0: Optional[float] = None,
+) -> None:
+    """Close out one attempt record on the dispatch thread that ran it.
+    With the replica's in-band waterfall (`remote`) the wall clocks
+    split the attempt into network send (our send wall -> the replica's
+    wall_t0) and receive (whatever the replica's own total cannot
+    explain — its post-response respond write and the socket read land
+    here). `outcome` is written LAST (the reader contract)."""
+    if attempt is None:
+        return
+    t1 = time.perf_counter()
+    attempt["t1"] = t1
+    dur = (t1 - (attempt["t0"] or t1)) * 1e3
+    attempt["dur_ms"] = round(dur, 3)
+    if remote is not None and isinstance(remote, dict):
+        attempt["remote"] = {
+            "request_id": remote.get("request_id"),
+            "replica": remote.get("replica"),
+            "span_id": remote.get("span_id"),
+            "stages": remote.get("stages") or [],
+        }
+        rw0 = remote.get("wall_t0")
+        if t_wall0 is not None and isinstance(rw0, (int, float)):
+            send = max(0.0, (rw0 - t_wall0) * 1e3)
+            attempt["net_send_ms"] = round(send, 3)
+            rtot = max(0.0, float(remote.get("total_ms") or 0.0))
+            attempt["net_recv_ms"] = round(max(0.0, dur - send - rtot), 3)
+    attempt["error"] = error
+    attempt["outcome"] = outcome
+
+
 class RouterMetrics:
     """Thread-safe router gauges; `payload()` is the `fleet_serve/*`
     core (the router's OWN client-observed latency/burn — the
@@ -230,18 +466,29 @@ class RouterMetrics:
         self._completed = 0
         self._win_completed = 0
         self._win_t0 = time.perf_counter()
+        # recent critical-path attributions (obs/critpath.py) — the
+        # aggregation window behind fleet_serve/critpath_<hop>_ms
+        self._critpath: deque = deque(maxlen=512)
 
-    def count(self, name: str, n: int = 1) -> None:
+    def count(self, name: str, n=1) -> None:
         with self._lock:
             self._counters[name] += n
 
     def record_request(self, latency_s: float, ok: bool) -> None:
+        # NOTE: only CLIENT-OBSERVED completions land here — a
+        # cancelled hedge lane's latency must never enter the p99
+        # histogram it exists to protect (it is accounted in the
+        # hedge_wasted_ms counter instead)
         ms = latency_s * 1e3
         with self._lock:
             self._latencies_ms.append(ms)
             self._completed += 1
             self._win_completed += 1
         self.burn.record(ok and ms <= self.slo_ms)
+
+    def record_critpath(self, attribution: dict) -> None:
+        with self._lock:
+            self._critpath.append(attribution)
 
     def record_failure(self) -> None:
         """A request the fleet failed (retries exhausted) or shed —
@@ -267,6 +514,7 @@ class RouterMetrics:
             )
             counters = dict(self._counters)
             completed = self._completed
+            attrs = list(self._critpath)
             out = {
                 "fleet_serve/requests": completed,
                 "fleet_serve/qps": qps,
@@ -276,10 +524,19 @@ class RouterMetrics:
             }
         for name in ("hedges", "hedge_wins", "shed", "failed", "drains"):
             out[f"fleet_serve/{name}"] = counters.get(name, 0)
+        # hedge-loser accounting: the cumulative cost of every cancelled
+        # lane (the latency that used to vanish with the discarded
+        # response)
+        out["fleet_serve/hedge_wasted_ms"] = round(
+            float(counters.get("hedge_wasted_ms", 0.0)), 3
+        )
         # the burn family under the fleet prefix: the ROUTER's own
         # client-observed burn — the chaos leg's acceptance gauge
         for k, v in self.burn.payload().items():
             out["fleet_serve/" + k.split("/", 1)[1]] = v
+        agg = critpath.aggregate(attrs)
+        if agg["traces"]:
+            out.update(critpath.metrics_payload(agg))
         return out
 
 
@@ -317,6 +574,11 @@ class FleetRouter:
         breaker_cooldown_cap_s: float = 30.0,
         drain_timeout_s: float = 60.0,
         readmit_timeout_s: float = 300.0,
+        workdir: str = None,
+        router_index: int = 0,
+        reqtrace: bool = True,
+        flight_requests: int = 256,
+        alert_spec: str = "fleet_default",
     ):
         if replica_urls is None:
             if supervisor is None:
@@ -342,6 +604,50 @@ class FleetRouter:
             slo_ms, objective=slo_objective, windows=burn_windows
         )
         self._sink = sink
+        # distributed-tracing consumers (module docstring): the fleet
+        # flight ring of stitched multi-hop waterfalls, the burn-rate
+        # alert engine that dumps it at the firing edge, and the
+        # per-router Perfetto stream when a workdir is given
+        self.workdir = workdir
+        self.router_index = int(router_index)
+        self._reqtrace = bool(reqtrace)
+        self.flight = FlightRecorder(
+            max_requests=flight_requests, replica=self.router_index
+        )
+        spec = (
+            serve_alert_spec(
+                slo_ms, windows=self.metrics.burn.windows, prefix="fleet_serve"
+            )
+            if alert_spec == "fleet_default"
+            else alert_spec
+        )
+        self._alerts = (
+            AlertEngine(
+                parse_rules(spec),
+                workdir=workdir,
+                process_index=self.router_index,
+                on_fire=self._on_alert,
+            )
+            if spec
+            else None
+        )
+        self._tracer = None
+        if workdir and self._reqtrace:
+            self._tracer = Tracer(
+                jsonl_path=os.path.join(
+                    workdir, f"trace_events.r{self.router_index}.jsonl"
+                ),
+                process_index=self.router_index,
+            )
+            self._write_router_anchor()
+        # completed router traces awaiting stitching + span emission —
+        # drained by the metrics flusher (bounded: a stalled flusher
+        # degrades to dropped traces, never unbounded memory)
+        self._trace_pending: deque = deque(maxlen=4 * flight_requests)
+        # itertools.count is GIL-atomic: the flusher and a
+        # /debug/flight handler may drain traces concurrently
+        self._lane = itertools.count()
+        self._flush_step = 0
         # ONE lock for all fleet state (handles + breakers + the
         # admission counter): no per-replica locks, no order to invert
         self._fleet_lock = tsan.make_lock("router.fleet")
@@ -390,6 +696,21 @@ class FleetRouter:
                     with server._fleet_lock:
                         snaps = [r.snapshot() for r in server._replicas]
                     self._json(200, {"replicas": snaps})
+                elif path == "/debug/flight":
+                    # on-demand fleet flight dump: the ring of stitched
+                    # multi-hop waterfalls (the router-side twin of the
+                    # replica's /debug/flight)
+                    server._drain_traces()
+                    body = server.flight.snapshot()
+                    if server.workdir:
+                        body["dump_path"] = server.flight.dump(
+                            server.workdir, reason="debug_request",
+                            extra={
+                                "slo_ms": server.metrics.slo_ms,
+                                "role": "router",
+                            },
+                        )
+                    self._json(200, body)
                 else:
                     self.send_error(404)
 
@@ -411,6 +732,15 @@ class FleetRouter:
                 shape = self.headers.get("X-Image-Shape")
                 if shape:
                     headers["X-Image-Shape"] = shape
+                # a client-carried trace context (an upstream gateway's
+                # X-Trace-Id/X-Parent-Span) is adopted; absent one the
+                # router mints the trace id — either way every dispatch
+                # attempt below propagates it to the replica
+                ctx_in = ctxprop.parse(
+                    self.headers.get("X-Trace-Id"),
+                    self.headers.get("X-Parent-Span"),
+                )
+                t_ing = time.perf_counter()
                 if not server._admit():
                     # load shedding: a counted 503 + Retry-After, never
                     # a silent drop (and it burns error budget)
@@ -426,12 +756,23 @@ class FleetRouter:
                         },
                     )
                     return
+                rtrace = None
+                if server._reqtrace:
+                    # backdated to handler entry so ingress covers the
+                    # body read; shed requests stay untraced (no
+                    # dispatch hops to attribute)
+                    rtrace = RouterRequestTrace(path, t0, ctx=ctx_in)
+                    rtrace.ingress_ms = round((t_ing - t0) * 1e3, 3)
+                    rtrace.admission_ms = round(
+                        (time.perf_counter() - t_ing) * 1e3, 3
+                    )
                 try:
                     status, payload, rep_index = retry_mod.retry_call(
                         server._attempt_hedged,
                         self.path,
                         body,
                         headers,
+                        rtrace,
                         site="router." + path.strip("/"),
                         attempts=server.retry_attempts,
                         base_delay=server.retry_base_delay_s,
@@ -442,11 +783,23 @@ class FleetRouter:
                     # retries exhausted across the fleet: loud 503
                     server.metrics.count("failed")
                     server.metrics.record_failure()
+                    err_body = {"error": f"fleet dispatch failed: {e}"}
+                    if rtrace is not None:
+                        err_body["trace_id"] = rtrace.trace_id
+                    t_resp = time.perf_counter()
                     self._json(
                         503,
-                        {"error": f"fleet dispatch failed: {e}"},
+                        err_body,
                         extra_headers={"Retry-After": "1"},
                     )
+                    if rtrace is not None:
+                        # the failed trace is still a trace: every dead
+                        # attempt attributed, no winner
+                        rtrace.respond_ms = round(
+                            (time.perf_counter() - t_resp) * 1e3, 3
+                        )
+                        rtrace.done(503)
+                        server._trace_complete(rtrace)
                     return
                 finally:
                     server._release()
@@ -457,7 +810,20 @@ class FleetRouter:
                     # replica attribution next to the replica-scoped
                     # request_id (r<i>-<seq>) the replica minted
                     payload.setdefault("replica", rep_index)
+                    if rtrace is not None:
+                        payload["trace_id"] = rtrace.trace_id
+                t_resp = time.perf_counter()
                 self._json(status, payload)
+                if rtrace is not None:
+                    rtrace.respond_ms = round(
+                        (time.perf_counter() - t_resp) * 1e3, 3
+                    )
+                    rtrace.done(
+                        status,
+                        payload.get("request_id")
+                        if isinstance(payload, dict) else None,
+                    )
+                    server._trace_complete(rtrace)
 
             def _handle_admin_drain(self, query):
                 idx = _parse_replica(query, len(server._replicas))
@@ -567,12 +933,30 @@ class FleetRouter:
             else:
                 rep.breaker.record_failure()
 
-    def _try_replica(self, rep: ReplicaHandle, path_q: str, body: bytes, headers: dict):
+    def _try_replica(
+        self, rep: ReplicaHandle, path_q: str, body: bytes, headers: dict,
+        attempt: Optional[dict] = None,
+    ):
         """One attempt against one replica (runs on the dispatch pool;
         no locks held across the network I/O). Returns (status, payload,
         replica_index); raises ReplicaAttemptError on anything worth
-        re-routing."""
-        req = urllib.request.Request(rep.url + path_q, data=body, headers=dict(headers))
+        re-routing. `attempt` is this lane's RouterRequestTrace record:
+        its span id rides downstream as X-Parent-Span, and the record is
+        finalized here — on the thread that ran the attempt — with the
+        outcome, the network send/recv split, and the replica's in-band
+        stage waterfall (popped off the payload)."""
+        hdrs = dict(headers)
+        t_wall0 = time.time()
+        if attempt is not None:
+            ctxprop.inject(
+                hdrs,
+                ctxprop.TraceContext(attempt["trace_id"], attempt["span_id"]),
+            )
+            attempt["t0"] = time.perf_counter()
+            attempt["start_ms"] = round(
+                (attempt["t0"] - attempt["origin_t0"]) * 1e3, 3
+            )
+        req = urllib.request.Request(rep.url + path_q, data=body, headers=hdrs)
         try:
             with urllib.request.urlopen(req, timeout=self.replica_timeout_s) as resp:
                 payload = json.loads(resp.read())
@@ -586,18 +970,26 @@ class FleetRouter:
                 except ValueError:
                     payload = {"error": f"replica {rep.index}: HTTP {e.code}"}
                 self._finish(rep, ok=True)
+                _finalize_attempt(attempt, "ok", error=f"HTTP {e.code}")
                 return e.code, payload, rep.index
             self._finish(rep, ok=False)
+            _finalize_attempt(attempt, "failed", error=f"HTTP {e.code}")
             raise ReplicaAttemptError(f"replica {rep.index}: HTTP {e.code}") from e
         except (OSError, TimeoutError) as e:  # URLError/socket resets/timeouts
             self._finish(rep, ok=False)
+            _finalize_attempt(attempt, "failed", error=repr(e))
             raise ReplicaAttemptError(f"replica {rep.index}: {e!r}") from e
         except ValueError as e:  # torn/garbled response body
             self._finish(rep, ok=False)
+            _finalize_attempt(attempt, "failed", error=repr(e))
             raise ReplicaAttemptError(
                 f"replica {rep.index}: bad response ({e!r})"
             ) from e
         self._finish(rep, ok=True)
+        remote = (
+            payload.pop("trace", None) if isinstance(payload, dict) else None
+        )
+        _finalize_attempt(attempt, "ok", remote=remote, t_wall0=t_wall0)
         return status, payload, rep.index
 
     def _hedge_delay_s(self) -> Optional[float]:
@@ -607,32 +999,61 @@ class FleetRouter:
         ms = max(self.hedge_min_ms, (p99 or 0.0) * self.hedge_p99_factor)
         return ms / 1e3
 
-    def _attempt_hedged(self, path_q: str, body: bytes, headers: dict):
+    def _attempt_hedged(
+        self, path_q: str, body: bytes, headers: dict,
+        rtrace: Optional[RouterRequestTrace] = None,
+    ):
         """One retry-round: dispatch to the best replica; if it outlives
         the hedge delay, duplicate to a second one and take the first
         success (first-winner — the loser's response is discarded when
-        it lands; urlopen cannot be cancelled mid-flight). Raises an
+        it lands; urlopen cannot be cancelled mid-flight, so the loser
+        lane is marked CANCELLED when it completes and its full cost is
+        booked to `hedge_wasted_ms` rather than vanishing). Raises an
         OSError subclass when the round produced no success, which is
         what the retry layer backs off on."""
         rep = self._acquire()
         if rep is None:
             raise ReplicaUnavailableError("no admitted replica to dispatch to")
-        primary = self._pool.submit(self._try_replica, rep, path_q, body, headers)
+        rnd = rtrace.next_round() if rtrace is not None else 0
+        att = (
+            rtrace.new_attempt(rep.index, rnd, "primary", rep.breaker.state)
+            if rtrace is not None else None
+        )
+        primary = self._pool.submit(
+            self._try_replica, rep, path_q, body, headers, att
+        )
         delay = self._hedge_delay_s()
         if delay is None:
-            return primary.result()
+            result = primary.result()
+            if att is not None:
+                att["winner"] = True
+            return result
         try:
-            return primary.result(timeout=delay)
+            result = primary.result(timeout=delay)
         except concurrent.futures.TimeoutError:
             pass  # primary is slow, not failed: hedge it
+        else:
+            if att is not None:
+                att["winner"] = True
+            return result
         second = self._acquire(exclude=(rep.index,))
-        attempts = [primary]
+        lanes = [(primary, att, time.perf_counter() - delay)]
         if second is not None:
             self.metrics.count("hedges")
-            attempts.append(
-                self._pool.submit(self._try_replica, second, path_q, body, headers)
+            att2 = (
+                rtrace.new_attempt(
+                    second.index, rnd, "hedge", second.breaker.state
+                )
+                if rtrace is not None else None
             )
-        pending = set(attempts)
+            lanes.append((
+                self._pool.submit(
+                    self._try_replica, second, path_q, body, headers, att2
+                ),
+                att2,
+                time.perf_counter(),
+            ))
+        pending = {fut for fut, _, _ in lanes}
         errors = []
         while pending:
             done, pending = concurrent.futures.wait(
@@ -641,14 +1062,39 @@ class FleetRouter:
             for fut in done:
                 err = fut.exception()
                 if err is None:
-                    if len(attempts) == 2 and fut is attempts[1]:
+                    if len(lanes) == 2 and fut is lanes[1][0]:
                         self.metrics.count("hedge_wins")
+                    for lfut, latt, lt0 in lanes:
+                        if lfut is fut:
+                            if latt is not None:
+                                latt["winner"] = True
+                        else:
+                            self._cancel_lane(lfut, latt, lt0)
                     return fut.result()
                 errors.append(err)
         raise ReplicaUnavailableError(
             "all attempts failed this round: "
             + "; ".join(repr(e) for e in errors)
         )
+
+    def _cancel_lane(self, fut, att: Optional[dict], t_lane0: float) -> None:
+        """Hedge-loser accounting: when the discarded lane completes
+        (urlopen can't be aborted mid-flight), mark its span cancelled
+        and book its full duration to the `hedge_wasted_ms` counter.
+        The lane's latency never reaches the p99 histogram — only
+        client-observed completions do (`RouterMetrics.record_request`)."""
+
+        def _book(f):
+            wasted = max(0.0, (time.perf_counter() - t_lane0) * 1e3)
+            if att is not None:
+                if att["dur_ms"] is not None:
+                    wasted = att["dur_ms"]
+                att["wasted_ms"] = round(wasted, 3)
+                if att["outcome"] in ("ok", "pending"):
+                    att["outcome"] = "cancelled"  # after wasted_ms (reader contract)
+            self.metrics.count("hedge_wasted_ms", round(wasted, 3))
+
+        fut.add_done_callback(_book)
 
     # -- health -----------------------------------------------------------
 
@@ -821,6 +1267,95 @@ class FleetRouter:
             out["io_retries"] = router_retries
         return out
 
+    # -- distributed-trace emission (off the request path) ---------------
+
+    def _trace_complete(self, rtrace: RouterRequestTrace) -> None:
+        """Handler-thread side: O(1) append; stitching, critical-path
+        attribution, flight filing, and span rendering all happen on
+        the flusher."""
+        self._trace_pending.append(rtrace)
+
+    def _drain_traces(self, force: bool = False) -> None:
+        """Emit every completed pending trace. A trace whose hedge
+        loser is still in flight is HELD BACK (re-queued) so the
+        stitched record carries the cancelled lane's real cost — up to
+        one replica-timeout of grace, then it goes out as-is. Safe for
+        concurrent callers (flusher + a /debug/flight handler): the
+        deque pops hand each trace to exactly one emitter."""
+        grace = self.replica_timeout_s
+        requeue = []
+        while True:
+            try:
+                rt = self._trace_pending.popleft()
+            except IndexError:
+                break
+            if (
+                not force
+                and not rt.complete()
+                and (time.perf_counter() - (rt.t_end or rt.t0)) < grace
+            ):
+                requeue.append(rt)
+                continue
+            self._emit_trace(rt)
+        for rt in requeue:
+            self._trace_pending.append(rt)
+
+    def _emit_trace(self, rtrace: RouterRequestTrace) -> None:
+        stitched = rtrace.stitched()
+        rec = dict(stitched)
+        rec["stages"] = critpath.flatten(stitched)
+        self.flight.record_request(rec)
+        self.metrics.record_critpath(critpath.attribute(stitched))
+        if self._tracer is not None:
+            _emit_router_spans(self._tracer, rtrace, next(self._lane))
+
+    def _write_router_anchor(self) -> None:
+        """Atomic `heartbeat.r<router_index>.json` with the tracer's
+        wall anchor — scripts/trace_merge.py clock-aligns the router
+        stream against the replica streams with it."""
+        rec = {
+            "process": self.router_index,
+            "role": "router",
+            "host": socket.gethostname(),
+            "pid": os.getpid(),
+            "time": time.time(),
+            "trace_wall_t0": self._tracer.wall_t0,
+        }
+        path = os.path.join(self.workdir, f"heartbeat.r{self.router_index}.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+        os.replace(tmp, path)
+
+    def _on_alert(self, alert: dict) -> None:
+        """AlertEngine on_fire hook: a fleet burn-rate (or p99) alert
+        dumps the DISTRIBUTED flight ring at the firing edge — the
+        postmortem file holds stitched multi-hop waterfalls, not one
+        process's view — and lands an in-band alert event line."""
+        if self.workdir:
+            try:
+                self.flight.dump(
+                    self.workdir,
+                    reason=f"alert:{alert['rule']}",
+                    extra={
+                        "alert": alert,
+                        "slo_ms": self.metrics.slo_ms,
+                        "role": "router",
+                    },
+                )
+            except Exception as e:  # the dump must never take the router down
+                print(f"WARNING: router flight dump failed: {e!r}", flush=True)
+        if self._sink is not None:
+            self._sink.write(
+                self._flush_step,
+                {
+                    "event": "alert",
+                    "alert": alert["rule"],
+                    "severity": alert["severity"],
+                    f"alert/{alert['rule']}": 1.0,
+                },
+            )
+
     def _flush_loop(self, interval: float) -> None:
         step = 0
         while not self._stop.wait(interval):
@@ -829,8 +1364,13 @@ class FleetRouter:
         self._write_metrics(step + 1)  # the run's last gauges land too
 
     def _write_metrics(self, step: int) -> None:
+        self._flush_step = step  # mocolint: disable=JX012  (flusher-thread only during the run; close() joins the flusher before its own final drain, so writers are join-serialized)
         try:
+            self._drain_traces()
             payload = self.stats()
+            self.flight.record_metrics(step, payload)
+            if self._alerts is not None:
+                self._alerts.observe(step, payload)
             if self._sink is not None:
                 self._sink.write(step, payload)
         except Exception as e:  # metrics must never take the router down
@@ -840,7 +1380,10 @@ class FleetRouter:
 
     def close(self) -> None:
         """Stop the poller/flusher/drain worker, shut HTTP, join all
-        four threads, and retire the dispatch pool (JX011 discipline)."""
+        four threads, and retire the dispatch pool (JX011 discipline).
+        After the pool drains, force-emit any held-back traces (a hedge
+        loser that never completed goes out with its lane pending) and
+        close the trace stream."""
         self._stop.set()
         self._health_thread.join(timeout=10.0)
         self._flusher.join(timeout=10.0)
@@ -849,6 +1392,11 @@ class FleetRouter:
         self._server.server_close()
         self._thread.join(timeout=10.0)
         self._pool.shutdown(wait=True, cancel_futures=True)
+        self._drain_traces(force=True)
+        if self._alerts is not None:
+            self._alerts.close()
+        if self._tracer is not None:
+            self._tracer.close()
 
 
 def _query_param(query: str, name: str) -> Optional[str]:
@@ -888,4 +1436,5 @@ __all__ = [
     "ReplicaHandle",
     "ReplicaUnavailableError",
     "RouterMetrics",
+    "RouterRequestTrace",
 ]

@@ -79,6 +79,7 @@ from collections import deque
 
 import numpy as np
 
+from moco_tpu.obs import ctxprop
 from moco_tpu.obs.alerts import AlertEngine, parse_rules
 from moco_tpu.obs.flight import FlightRecorder
 from moco_tpu.obs.reqtrace import RequestIdAllocator, emit_request_spans
@@ -298,11 +299,20 @@ class ServeServer:
                             f"(serving: {sorted(server._prepared_modes)})"
                         })
                         return
+                # adopt the propagated trace context when the fleet
+                # front door sent one — this replica's waterfall becomes
+                # a child of the router's dispatch-attempt span
+                ctx = ctxprop.parse(
+                    self.headers.get("X-Trace-Id"),
+                    self.headers.get("X-Parent-Span"),
+                )
                 trace = None
                 if server._ids is not None:
                     # backdated to arrival so the ingress stage covers
                     # the body read + parse above
-                    trace = server._ids.new_trace(images.shape[0], t0=t_arrival)
+                    trace = server._ids.new_trace(
+                        images.shape[0], t0=t_arrival, ctx=ctx
+                    )
                     trace.stamp("ingress", t_arrival, time.perf_counter())
                 try:
                     fut = server.batcher.submit(
@@ -323,6 +333,13 @@ class ServeServer:
                     body["mode"] = eff
                 if trace is not None:
                     body["request_id"] = trace.req_id
+                    if trace.trace_id is not None:
+                        # in-band stitching: ship the stage waterfall (as
+                        # stamped so far — respond lands in the router's
+                        # net_recv slack) back to the router with the
+                        # response, so the router can attribute this hop
+                        # without waiting for an offline trace merge
+                        body["trace"] = trace.waterfall()
                 self._json(200, body)
                 if trace is not None:
                     trace.stamp("respond", t_respond, time.perf_counter())

@@ -64,15 +64,27 @@ SERVE_PORT_STRIDE = 16
 # in particular it must NEVER retry /ingest (appends queue rows; the
 # fan-out writer in scripts/serve_ingest.py owns its own idempotence
 # via row-count reconciliation).
+#
+# `opt_headers` are the PROPAGATED headers (obs/ctxprop.py): a plain
+# client may omit them, but every handler of the route must read them —
+# JX016 checks the handler side only, so adding one here never flags
+# existing clients.
+
+# distributed-tracing context headers (obs/ctxprop.py mints/parses them)
+TRACE_HEADERS = ("X-Trace-Id", "X-Parent-Span")
 
 
 class Route:
-    __slots__ = ("path", "methods", "headers", "idempotent", "server")
+    __slots__ = ("path", "methods", "headers", "opt_headers", "idempotent", "server")
 
-    def __init__(self, path, methods, headers=(), idempotent=False, server="both"):
+    def __init__(
+        self, path, methods, headers=(), opt_headers=(), idempotent=False,
+        server="both",
+    ):
         self.path = path
         self.methods = tuple(methods)
         self.headers = tuple(headers)
+        self.opt_headers = tuple(opt_headers)
         self.idempotent = idempotent
         self.server = server
 
@@ -84,12 +96,13 @@ ROUTES = {
         # the Prometheus scrape endpoint (obs/sinks.py PrometheusSink)
         Route("/metrics", ("GET",), idempotent=True, server="metrics"),
         Route("/stats", ("GET",), idempotent=True, server="both"),
-        Route("/debug/flight", ("GET",), idempotent=True, server="replica"),
+        Route("/debug/flight", ("GET",), idempotent=True, server="both"),
         Route("/admin/replicas", ("GET",), idempotent=True, server="router"),
         Route(
             "/embed",
             ("POST",),
             headers=("X-Image-Shape",),
+            opt_headers=TRACE_HEADERS,
             idempotent=True,
             server="both",
         ),
@@ -97,6 +110,7 @@ ROUTES = {
             "/neighbors",
             ("POST",),
             headers=("X-Image-Shape",),
+            opt_headers=TRACE_HEADERS,
             idempotent=True,
             server="both",
         ),
@@ -114,6 +128,7 @@ ROUTES = {
 
 IDEMPOTENT_ROUTES = tuple(sorted(p for p, r in ROUTES.items() if r.idempotent))
 REQUIRED_HEADERS = {p: r.headers for p, r in ROUTES.items() if r.headers}
+OPTIONAL_HEADERS = {p: r.opt_headers for p, r in ROUTES.items() if r.opt_headers}
 
 
 def route_methods(path: str) -> tuple:
@@ -182,4 +197,13 @@ SERVE_GATED_VALIDATORS = (
     "serve/quant_tier",
     "serve/recall_estimate",
     "serve/slo_objective",
+)
+
+# The distributed-tracing validators the ROUTER's metric stream must
+# exercise in a full fleet smoke (critical-path attribution + the
+# hedge-loser cost counter — both only emitted by serve/router.py).
+
+FLEET_GATED_VALIDATORS = (
+    "fleet_serve/critpath_",
+    "fleet_serve/hedge_wasted_ms",
 )

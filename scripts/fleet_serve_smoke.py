@@ -37,14 +37,27 @@ The story, executable:
    discovers the topology from `/admin/replicas` and lands a fresh
    block on EVERY replica (per-replica `ingest.post.r<i>` retry
    sites);
-7. final gates: `fleet_serve/burn_rate_60s` < 1.0 (the chaos never
+7. the tracing leg (ISSUE 18): every burst response carries the
+   router-minted `trace_id`, and the router's `/debug/flight` ring must
+   hold a stitched multi-hop waterfall for 100% of them — with the
+   critical-path hop sum (obs/critpath.py) within eps of the CLIENT-
+   measured wall, and every 200's winning attempt joined to a real
+   replica waterfall. After teardown `scripts/trace_merge.py` merges
+   the router stream (pid 200) with every replica stream into
+   `merged_fleet_trace.json` — flow arrows (`ph:"s"`/`ph:"f"`) must
+   link router attempts to replica requests — and the offline
+   `stitch_traces()` twin must reproduce stitched records from the
+   on-disk artifacts alone;
+8. final gates: `fleet_serve/burn_rate_60s` < 1.0 (the chaos never
    exhausted the client-observed error budget), the flushed
-   `fleet_serve/*` metrics lines schema-strict, and mocolint clean on
-   the fleet modules (JX011/JX012/JX013 — the threaded router must
-   lint clean, not just run clean).
+   `fleet_serve/*` metrics lines schema-strict (including the
+   `fleet_serve/critpath_<hop>_ms` family), and mocolint clean on the
+   fleet modules (JX011/JX012/JX013 — the threaded router must lint
+   clean, not just run clean).
 
-CI runs this in the tier-1 job; the router metrics stream, the summary
-JSON, and the supervisor event log upload as artifacts.
+CI runs this in the tier-1 job; the router metrics stream, the merged
+fleet trace, the router flight dump, the summary JSON, and the
+supervisor event log upload as artifacts.
 """
 
 from __future__ import annotations
@@ -84,6 +97,11 @@ SLOW_MS = 2500.0
 KILL_AT = 5  # replica 1 dies handling its 5th data POST — mid-burst
 RESPAWN_DEADLINE_S = 420.0
 DRAIN_DEADLINE_S = 420.0
+# stitched hop-sum vs client wall: relative eps dominates at the smoke's
+# realistic latencies; the absolute floor covers the fast path
+TRACE_EPS_FRAC = 0.15
+TRACE_EPS_FLOOR_MS = 25.0
+STITCH_DEADLINE_S = 120.0  # hedge losers (the 2.5s lane) must land first
 
 
 def _get(url: str, timeout: float = 10.0) -> dict:
@@ -96,7 +114,7 @@ def run_smoke(workdir: str, contract_coverage: bool = False) -> dict:
 
     import serve_smoke
     from moco_tpu.analysis import contracts as contract_cov
-    from moco_tpu.obs import schema
+    from moco_tpu.obs import critpath, schema
     from moco_tpu.obs.sinks import JsonlSink
     from moco_tpu.serve.fleet import ReplicaSupervisor
     from moco_tpu.serve.router import FleetRouter
@@ -158,6 +176,9 @@ def run_smoke(workdir: str, contract_coverage: bool = False) -> dict:
         breaker_cooldown_s=1.0,
         drain_timeout_s=60.0,
         readmit_timeout_s=DRAIN_DEADLINE_S,
+        # distributed tracing: per-router Perfetto stream + clock anchor
+        # land next to the replicas' streams for the offline merge
+        workdir=workdir,
     )
     base = f"http://127.0.0.1:{router.port}"
     canned = {
@@ -167,16 +188,22 @@ def run_smoke(workdir: str, contract_coverage: bool = False) -> dict:
     }
     failures: list[str] = []
     replicas_seen: set = set()
+    traced: dict = {}  # trace_id -> client-measured wall ms (burst only)
     lock = threading.Lock()
 
-    def post(path: str, imgs) -> dict:
+    def post(path: str, imgs, record_trace: bool = False) -> dict:
         req = urllib.request.Request(
             base + path,
             data=imgs.tobytes(),
             headers={"X-Image-Shape": ",".join(map(str, imgs.shape))},
         )
+        t0 = time.perf_counter()
         with urllib.request.urlopen(req, timeout=120) as r:
-            return json.loads(r.read())
+            out = json.loads(r.read())
+        if record_trace and isinstance(out, dict) and out.get("trace_id"):
+            with lock:
+                traced[out["trace_id"]] = (time.perf_counter() - t0) * 1e3
+        return out
 
     def check_response(out: dict, n: int) -> None:
         emb = np.asarray(out["embedding"], np.float32)
@@ -196,7 +223,7 @@ def run_smoke(workdir: str, contract_coverage: bool = False) -> dict:
             n = int(crng.choice(REQUEST_SIZES))
             path = "/neighbors?k=3" if (ci + j) % 2 == 0 else "/embed"
             try:
-                check_response(post(path, canned[n]), n)
+                check_response(post(path, canned[n], record_trace=True), n)
             except Exception as e:
                 with lock:
                     failures.append(f"client {ci} req {j}: {e!r}")
@@ -221,6 +248,63 @@ def run_smoke(workdir: str, contract_coverage: bool = False) -> dict:
         assert not failures, f"{len(failures)} requests failed: {failures[:5]}"
         print(f"burst clean in {burst_s:.1f}s; replicas seen: {sorted(replicas_seen)}",
               flush=True)
+
+        # -- 100% stitched traces, hop sums within eps of client walls -----
+        assert len(traced) == BURST_REQUESTS, (
+            f"only {len(traced)}/{BURST_REQUESTS} responses carried a trace_id"
+        )
+        deadline = time.monotonic() + STITCH_DEADLINE_S
+        flight_body: dict = {}
+        flight_recs: dict = {}
+        while time.monotonic() < deadline:
+            # /debug/flight drains pending traces; held-back hedge
+            # losers (the 2.5s slowed lane) land within their grace
+            flight_body = _get(base + "/debug/flight", timeout=60)
+            flight_recs = {
+                r["trace_id"]: r
+                for r in flight_body.get("requests", ())
+                if r.get("trace_id")
+            }
+            if set(traced) <= set(flight_recs):
+                break
+            time.sleep(1.0)
+        missing_traces = sorted(set(traced) - set(flight_recs))
+        assert not missing_traces, (
+            f"{len(missing_traces)}/{len(traced)} burst traces never "
+            f"stitched into the flight ring: {missing_traces[:3]}"
+        )
+        hop_errs = []
+        hedged_traces = retried_traces = 0
+        for tid, wall_ms in traced.items():
+            rec = flight_recs[tid]
+            attr = critpath.attribute(rec)
+            ssum = sum(attr["hops"].values())
+            eps = max(TRACE_EPS_FRAC * wall_ms, TRACE_EPS_FLOOR_MS)
+            if abs(ssum - wall_ms) > eps:
+                hop_errs.append(
+                    f"{tid}: hop sum {ssum:.1f}ms vs client wall "
+                    f"{wall_ms:.1f}ms (eps {eps:.1f}ms)"
+                )
+            winner = next(
+                (a for a in rec["attempts"] if a.get("winner")), None
+            )
+            if rec.get("status") == 200 and (
+                winner is None or not winner.get("remote")
+            ):
+                hop_errs.append(
+                    f"{tid}: 200 with no replica waterfall stitched in"
+                )
+            hedged_traces += 1 if attr["hedged"] else 0
+            retried_traces += 1 if attr["retry_failed_ms"] else 0
+        assert not hop_errs, (
+            f"{len(hop_errs)} stitched traces failed the hop-sum/"
+            f"stitching gate: {hop_errs[:5]}"
+        )
+        print(f"tracing: {len(traced)} burst traces 100% stitched "
+              f"({hedged_traces} hedged, {retried_traces} with a failed "
+              f"attempt on the critical path); hop sums within eps of "
+              f"client walls", flush=True)
+        summary["router_flight_dump"] = flight_body.get("dump_path")
 
         # -- the corpse respawns, scrubbed and WARM ------------------------
         deadline = time.monotonic() + RESPAWN_DEADLINE_S
@@ -390,6 +474,32 @@ def run_smoke(workdir: str, contract_coverage: bool = False) -> dict:
     problems = schema.validate_file(os.path.join(workdir, "metrics.jsonl"))
     assert not problems, f"router metrics schema violations: {problems[:5]}"
 
+    # -- offline merge: router + replica streams on one clock --------------
+    # trace_merge must find the router track and link at least one
+    # router/attempt -> replica request flow arrow; its offline stitcher
+    # (heartbeat-anchored, no in-band echo) must reproduce waterfalls.
+    # The killed replica's stream dies with it, so the offline gate is
+    # "non-empty and consistent", while the in-band gate above is 100%.
+    import trace_merge
+
+    merged_path = os.path.join(workdir, "merged_fleet_trace.json")
+    tm_summary = trace_merge.merge_traces(workdir, merged_path)
+    assert 0 in tm_summary["routers"], (
+        f"trace_merge never found the router stream: {tm_summary}"
+    )
+    assert tm_summary["flow_events"] >= 1, (
+        "trace_merge linked no router attempt -> replica request flows"
+    )
+    offline = trace_merge.stitch_traces(workdir)
+    assert offline, "offline stitcher reconstructed no traces"
+    summary["merged_trace"] = merged_path
+    summary["flow_pairs"] = tm_summary["flow_events"]
+    summary["offline_stitched"] = len(offline)
+    print(f"offline merge: {len(tm_summary['routers'])} router + "
+          f"{len(tm_summary['serve_replicas'])} replica streams on one clock, "
+          f"{tm_summary['flow_events']} flow arrows, "
+          f"{len(offline)} traces re-stitched offline", flush=True)
+
     if recorder is not None:
         # validate each replica's serve/* stream too — with the recorder
         # still wired into obs/schema this doubles as validator coverage
@@ -415,11 +525,15 @@ def run_smoke(workdir: str, contract_coverage: bool = False) -> dict:
         gate_faults = [f"slow@{s}" for s in decl.SERVE_STAGE_SITES] + [
             "kill@replica"
         ]
+        gate_validators = tuple(decl.SERVE_GATED_VALIDATORS) + tuple(
+            decl.FLEET_GATED_VALIDATORS
+        )
         missing = contract_cov.check_coverage(
             cov,
             routes=gate_routes,
             fault_sites=gate_faults,
-            validators=decl.SERVE_GATED_VALIDATORS,
+            validators=gate_validators,
+            headers=decl.TRACE_HEADERS,
         )
         with open(os.path.join(workdir, "contract_coverage.json"), "w") as f:
             json.dump({
@@ -427,7 +541,8 @@ def run_smoke(workdir: str, contract_coverage: bool = False) -> dict:
                 "gates": {
                     "routes": gate_routes,
                     "fault_sites": gate_faults,
-                    "validators": list(decl.SERVE_GATED_VALIDATORS),
+                    "validators": list(gate_validators),
+                    "headers": list(decl.TRACE_HEADERS),
                 },
                 "missing": missing,
             }, f, indent=2, sort_keys=True)
@@ -438,6 +553,7 @@ def run_smoke(workdir: str, contract_coverage: bool = False) -> dict:
             "routes": len(cov["routes"]),
             "fault_hooks": len(cov["fault_hooks"]),
             "validators": len(cov["validators"]),
+            "headers": len(cov.get("headers", {})),
             "missing": 0,
         }
 

@@ -101,6 +101,23 @@ def _trend(lines: list[dict], key: str) -> str | None:
     )
 
 
+def _flight_dumps(workdir: str | None, role: str | None) -> list[tuple[str, dict]]:
+    """(path, dump) for every parseable flight_*.json under `workdir`,
+    oldest first, filtered by the dump's `role` stamp — `"router"` for
+    the fleet router's stitched-waterfall dumps, None for a replica's
+    own (unstamped or role="serve") dumps."""
+    out = []
+    for path in sorted(globmod.glob(os.path.join(workdir, "flight_*.json"))) if workdir else []:
+        try:
+            with open(path) as f:
+                dump = json.load(f)
+        except (ValueError, OSError):
+            continue
+        if (dump.get("role") == "router") == (role == "router"):
+            out.append((path, dump))
+    return out
+
+
 def metrics_paths_for(source: str) -> list[str]:
     """All per-process metrics files of a workdir (process 0's
     `metrics.jsonl` first), or the single file the caller named."""
@@ -313,17 +330,13 @@ def render_report(
                 w(f"- `{key}`: {_spark(vals)}  last {_fmt(vals[-1])} "
                   f"(max {_fmt(max(vals))}; >1 = burning budget faster "
                   "than the SLO period sustains)")
-        # top-N slowest requests from the newest flight dump
-        dumps = sorted(globmod.glob(os.path.join(workdir, "flight_*.json"))) if workdir else []
-        if dumps:
-            try:
-                with open(dumps[-1]) as f:
-                    dump = json.load(f)
-            except ValueError:
-                dump = None
-            if dump and dump.get("slowest"):
+        # top-N slowest requests from the newest REPLICA flight dump
+        # (router dumps carry role="router" and render in Fleet tracing)
+        if _flight_dumps(workdir, role=None):
+            path, dump = _flight_dumps(workdir, role=None)[-1]
+            if dump.get("slowest"):
                 w("")
-                w(f"slowest requests (flight recorder `{os.path.basename(dumps[-1])}`, "
+                w(f"slowest requests (flight recorder `{os.path.basename(path)}`, "
                   f"reason: {dump.get('reason', '?')}):")
                 for wf in dump["slowest"][:5]:
                     stages_str = " ".join(
@@ -333,6 +346,72 @@ def render_report(
                     w(f"- `{wf.get('request_id', '?')}` "
                       f"({wf.get('total_ms', 0):.0f} ms, {wf.get('rows', '?')} rows): "
                       f"{stages_str}")
+        w("")
+
+    # -- fleet tracing (stitched distributed waterfalls) ------------------
+    fleet_lines = [
+        r for r in records if any(k.startswith("fleet_serve/") for k in r)
+    ]
+    if fleet_lines:
+        w("## Fleet tracing")
+        w("")
+        last = fleet_lines[-1]
+        reqs = last.get("fleet_serve/requests")
+        if isinstance(reqs, (int, float)):
+            w(f"requests through the front door: {int(reqs)}, "
+              f"slo {_fmt(last.get('fleet_serve/slo_ms'))} ms, "
+              f"p99 {_fmt(last.get('fleet_serve/p99_ms'))} ms")
+        # critical-path pie: which hop of the distributed request ate
+        # the milliseconds (obs/critpath.py attribution, latest window)
+        crit_line = next(
+            (r for r in reversed(fleet_lines)
+             if any(k.startswith("fleet_serve/critpath_") for k in r)),
+            None,
+        )
+        if crit_line:
+            hops = {
+                k[len("fleet_serve/critpath_"):-len("_ms")]: v
+                for k, v in crit_line.items()
+                if k.startswith("fleet_serve/critpath_") and k.endswith("_ms")
+                and isinstance(v, (int, float))
+            }
+            total = sum(hops.values()) or 1.0
+            w("")
+            w("critical path (mean ms/request, latest window):")
+            for name, ms in sorted(hops.items(), key=lambda kv: -kv[1]):
+                frac = ms / total
+                w(f"  {name:<22} {_bar(frac)} {frac * 100:5.1f}%  ({ms:.1f} ms)")
+        hedges = last.get("fleet_serve/hedges")
+        if isinstance(hedges, (int, float)) and hedges:
+            wins = last.get("fleet_serve/hedge_wins") or 0
+            w(f"- hedges: {int(hedges)} (win rate {wins / hedges * 100:.0f}%); "
+              f"{_fmt(last.get('fleet_serve/hedge_wasted_ms'))} ms burned in "
+              "cancelled loser lanes")
+        retries = last.get("fleet_serve/retries")
+        if isinstance(retries, (int, float)) and retries:
+            retry_ms = (
+                crit_line.get("fleet_serve/critpath_retry_failed_ms")
+                if crit_line else None
+            )
+            w(f"- retries: {int(retries)}; failed-attempt wait on the "
+              f"critical path: {_fmt(retry_ms)} ms (mean over traced requests)")
+        # top-5 slowest stitched multi-hop waterfalls
+        router_dumps = _flight_dumps(workdir, role="router")
+        if router_dumps and router_dumps[-1][1].get("slowest"):
+            path, dump = router_dumps[-1]
+            w("")
+            w(f"slowest distributed waterfalls (router flight "
+              f"`{os.path.basename(path)}`, reason: {dump.get('reason', '?')}):")
+            for wf in dump["slowest"][:5]:
+                stages_str = " ".join(
+                    f"{s['stage']}={s['dur_ms']:.0f}ms"
+                    for s in wf.get("stages", [])
+                )
+                w(f"- `{wf.get('trace_id', '?')}` -> "
+                  f"`{wf.get('request_id', '?')}` "
+                  f"({wf.get('total_ms', 0):.0f} ms, "
+                  f"status {wf.get('status', '?')}, "
+                  f"{len(wf.get('attempts') or ())} attempt(s)): {stages_str}")
         w("")
 
     # -- alerts ----------------------------------------------------------

@@ -216,9 +216,17 @@ def check(ledger_path: str, input_path: str, threshold: float | None = None) -> 
             lambda e: e.get("serving"),
         )
         # hard cap on the request-tracing overhead (per-request
-        # waterfalls must stay ~free or serving runs them off in prod)
-        overhead = serving.get("trace_overhead_pct")
-        if overhead is not None:
+        # waterfalls must stay ~free or serving runs them off in prod).
+        # Two series under the same caps: the replica-side batcher A/B
+        # (ISSUE 10) and the router-side distributed-tracing A/B
+        # (ISSUE 18 — context injection, attempt spans, flight ring).
+        for field, label in (
+            ("trace_overhead_pct", "request-tracing"),
+            ("router_trace_overhead_pct", "router distributed-tracing"),
+        ):
+            overhead = serving.get(field)
+            if overhead is None:
+                continue
             cap = (
                 TRACE_OVERHEAD_CAP_CPU
                 if "cpu_smoke" in serving["metric"]
@@ -226,13 +234,13 @@ def check(ledger_path: str, input_path: str, threshold: float | None = None) -> 
             )
             if overhead > cap:
                 print(
-                    f"perf gate [FAIL] {serving['metric']}: request-tracing "
+                    f"perf gate [FAIL] {serving['metric']}: {label} "
                     f"overhead {overhead:.1f}% above the {cap:g}% cap"
                 )
                 rc |= 1
             else:
                 print(
-                    f"perf gate [PASS] {serving['metric']}: request-tracing "
+                    f"perf gate [PASS] {serving['metric']}: {label} "
                     f"overhead {overhead:.1f}% (cap {cap:g}%)"
                 )
         # quantized-engine tiers (ISSUE 11): both tiers must hold the

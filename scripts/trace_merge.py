@@ -26,12 +26,28 @@ on virtual "requests" lanes) to `trace_events.s<replica>.jsonl` with a
 pid = `SERVE_PID_BASE + replica` (offset so a serve replica co-hosted
 with training process 0 gets its own track group) against the SAME
 clock origin — so "the p99 request on replica 2" lines up under "step
-40 on host 0" and a balanced fleet stays debuggable.
+40 on host 0" and a balanced fleet stays debuggable. Fleet replicas
+spawned by serve/fleet.py keep their streams in per-replica workdirs
+(`<workdir>/replica<i>/`); discovery looks one level deep for them.
+
+The fleet ROUTER (serve/router.py, PR 18) joins as its own track group
+at pid = `ROUTER_PID_BASE + router_index` from
+`trace_events.r<i>.jsonl` + `heartbeat.r<i>.json`, clock-corrected the
+same way. Router dispatch-attempt spans carry the propagated span ids
+(obs/ctxprop.py), so the merge also emits Chrome FLOW events
+(`ph:"s"`/`ph:"f"`, one per attempt→replica-request pair joined on
+`X-Parent-Span`): Perfetto draws the cross-process arrow from each
+router attempt into the replica request it carried.
+
+`stitch_traces()` is the offline twin of the router's in-band
+stitching: it joins the router + replica streams by trace id (clock-
+aligned via the heartbeat anchors) into one obs/critpath.py
+stitched-trace record per request — what the fleet smoke gates on.
 
 Open the output in https://ui.perfetto.dev — one track group per host
-plus one per serving replica. A process with no heartbeat (it died
-before its first beat, or a pre-fleet run) merges with zero offset and
-a warning in `otherData`.
+plus one per serving replica and one per router. A process with no
+heartbeat (it died before its first beat, or a pre-fleet run) merges
+with zero offset and a warning in `otherData`.
 
 Needs only the stdlib + moco_tpu.obs (no jax), so it runs wherever the
 files were copied.
@@ -53,10 +69,13 @@ from moco_tpu.obs.trace import spans_to_chrome_events  # noqa: E402
 
 _PROC_RE = re.compile(r"trace_events\.p(\d+)\.jsonl$")
 _SERVE_RE = re.compile(r"trace_events\.s(\d+)\.jsonl$")
+_ROUTER_RE = re.compile(r"trace_events\.r(\d+)\.jsonl$")
 
 # Serving-replica track-group offset: replica i renders as pid
 # SERVE_PID_BASE + i, clear of any plausible training host index.
 SERVE_PID_BASE = 100
+# The fleet router's track group, clear of the replica band.
+ROUTER_PID_BASE = 200
 
 
 def discover_streams(workdir: str) -> dict[int, str]:
@@ -73,12 +92,33 @@ def discover_streams(workdir: str) -> dict[int, str]:
     return streams
 
 
+def _glob_shallow_and_one_deep(workdir: str, pattern: str) -> list[str]:
+    """Matches at the workdir top level plus one directory deep — fleet
+    replicas (serve/fleet.py) keep their files in
+    `<workdir>/replica<i>/`."""
+    return glob.glob(os.path.join(workdir, pattern)) + glob.glob(
+        os.path.join(workdir, "*", pattern)
+    )
+
+
 def discover_serve_streams(workdir: str) -> dict[int, str]:
     """{replica_index: span-stream path} for every serving replica's
-    `trace_events.s<i>.jsonl` under `workdir`."""
+    `trace_events.s<i>.jsonl` under `workdir` (top level or one
+    subdirectory deep)."""
     streams: dict[int, str] = {}
-    for path in glob.glob(os.path.join(workdir, "trace_events.s*.jsonl")):
+    for path in _glob_shallow_and_one_deep(workdir, "trace_events.s*.jsonl"):
         m = _SERVE_RE.search(path)
+        if m:
+            streams[int(m.group(1))] = path
+    return streams
+
+
+def discover_router_streams(workdir: str) -> dict[int, str]:
+    """{router_index: span-stream path} for every fleet router's
+    `trace_events.r<i>.jsonl` under `workdir`."""
+    streams: dict[int, str] = {}
+    for path in _glob_shallow_and_one_deep(workdir, "trace_events.r*.jsonl"):
+        m = _ROUTER_RE.search(path)
         if m:
             streams[int(m.group(1))] = path
     return streams
@@ -89,11 +129,26 @@ def read_serve_anchors(workdir: str) -> dict[int, dict]:
     `heartbeat.s<i>.json` files ServeServer writes (same shape as the
     fleet heartbeats, plus role="serve"); unparseable files skipped."""
     out: dict[int, dict] = {}
-    for path in glob.glob(os.path.join(workdir, "heartbeat.s*.json")):
+    for path in _glob_shallow_and_one_deep(workdir, "heartbeat.s*.json"):
         try:
             with open(path) as f:
                 rec = json.load(f)
             out[int(rec["process"])] = rec
+        except (ValueError, KeyError, OSError):
+            continue
+    return out
+
+
+def read_router_anchors(workdir: str) -> dict[int, dict]:
+    """{router_index: anchor record} from the `heartbeat.r<i>.json`
+    files FleetRouter writes (role="router")."""
+    out: dict[int, dict] = {}
+    for path in _glob_shallow_and_one_deep(workdir, "heartbeat.r*.json"):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+            if rec.get("role") == "router":
+                out[int(rec["process"])] = rec
         except (ValueError, KeyError, OSError):
             continue
     return out
@@ -115,16 +170,55 @@ def read_spans(path: str) -> list[dict]:
     return out
 
 
+def _flow_events(attempt_spans: list[dict], request_spans: list[dict]) -> list[dict]:
+    """Chrome flow events linking each router `router/attempt` span to
+    the replica `request` span it dispatched — joined on the propagated
+    span id (the attempt's `span_id` arrives at the replica as
+    `X-Parent-Span` and comes back in its request span's
+    `parent_span`). Perfetto renders one arrow per pair.
+
+    Inputs are pre-positioned events: each carries the clock-corrected
+    `ts` plus the pid/tid of the track it renders on."""
+    by_parent: dict[str, dict] = {}
+    for ev in request_spans:
+        parent = (ev.get("args") or {}).get("parent_span")
+        if parent:
+            by_parent[parent] = ev
+    flows: list[dict] = []
+    for ev in attempt_spans:
+        span_id = (ev.get("args") or {}).get("span_id")
+        target = by_parent.get(span_id)
+        if target is None:
+            continue
+        common = {"name": "dispatch", "cat": "fleet", "id": span_id}
+        flows.append(
+            {**common, "ph": "s", "pid": ev["pid"], "tid": ev["tid"], "ts": ev["ts"]}
+        )
+        flows.append(
+            {
+                **common,
+                "ph": "f",
+                "bp": "e",
+                "pid": target["pid"],
+                "tid": target["tid"],
+                "ts": target["ts"],
+            }
+        )
+    return flows
+
+
 def merge_traces(workdir: str, output: str) -> dict:
     """Merge every per-process span stream under `workdir` into one
     Chrome trace at `output`; returns a summary dict (process count,
     span counts, applied offsets)."""
     streams = discover_streams(workdir)
     serve_streams = discover_serve_streams(workdir)
-    if not streams and not serve_streams:
+    router_streams = discover_router_streams(workdir)
+    if not streams and not serve_streams and not router_streams:
         raise FileNotFoundError(f"no trace_events*.jsonl under {workdir}")
     beats = read_heartbeats(workdir)
     serve_beats = read_serve_anchors(workdir)
+    router_beats = read_router_anchors(workdir)
     anchors = {
         p: rec["trace_wall_t0"]
         for p, rec in beats.items()
@@ -135,12 +229,25 @@ def merge_traces(workdir: str, output: str) -> dict:
         for r, rec in serve_beats.items()
         if isinstance(rec.get("trace_wall_t0"), (int, float))
     }
-    # ONE clock origin across training hosts AND serving replicas, so a
-    # request span lines up under the training step it rode alongside
-    all_anchors = list(anchors.values()) + list(serve_anchors.values())
+    router_anchors = {
+        r: rec["trace_wall_t0"]
+        for r, rec in router_beats.items()
+        if isinstance(rec.get("trace_wall_t0"), (int, float))
+    }
+    # ONE clock origin across training hosts, serving replicas AND the
+    # fleet router, so a request span lines up under the training step
+    # it rode alongside and the router->replica arrows point forward
+    all_anchors = (
+        list(anchors.values())
+        + list(serve_anchors.values())
+        + list(router_anchors.values())
+    )
     origin = min(all_anchors) if all_anchors else 0.0
     events: list[dict] = []
-    summary = {"processes": {}, "serve_replicas": {}, "unanchored": []}
+    summary = {"processes": {}, "serve_replicas": {}, "routers": {}, "unanchored": []}
+    # positioned spans collected for the flow-event join
+    attempt_events: list[dict] = []
+    request_events: list[dict] = []
     for p in sorted(streams):
         spans = read_spans(streams[p])
         offset_us = (anchors[p] - origin) * 1e6 if p in anchors else 0.0
@@ -165,22 +272,57 @@ def merge_traces(workdir: str, output: str) -> dict:
             summary["unanchored"].append(f"s{r}")
         host = serve_beats.get(r, {}).get("host")
         name = f"serve replica {r}" + (f" ({host})" if host else "")
-        events.extend(
-            spans_to_chrome_events(
-                spans,
-                pid=SERVE_PID_BASE + r,
-                process_name=name,
-                ts_offset_us=offset_us,
-            )
+        chrome = spans_to_chrome_events(
+            spans,
+            pid=SERVE_PID_BASE + r,
+            process_name=name,
+            ts_offset_us=offset_us,
+        )
+        events.extend(chrome)
+        request_events.extend(
+            ev
+            for ev in chrome
+            if ev.get("ph") == "X"
+            and ev.get("name") == "request"
+            and (ev.get("args") or {}).get("parent_span")
         )
         summary["serve_replicas"][r] = {
             "spans": len(spans),
             "offset_us": round(offset_us, 1),
             "host": host,
         }
+    for r in sorted(router_streams):
+        spans = read_spans(router_streams[r])
+        offset_us = (router_anchors[r] - origin) * 1e6 if r in router_anchors else 0.0
+        if r not in router_anchors:
+            summary["unanchored"].append(f"r{r}")
+        host = router_beats.get(r, {}).get("host")
+        name = f"fleet router {r}" + (f" ({host})" if host else "")
+        chrome = spans_to_chrome_events(
+            spans,
+            pid=ROUTER_PID_BASE + r,
+            process_name=name,
+            ts_offset_us=offset_us,
+        )
+        events.extend(chrome)
+        attempt_events.extend(
+            ev
+            for ev in chrome
+            if ev.get("ph") == "X" and ev.get("name") == "router/attempt"
+        )
+        summary["routers"][r] = {
+            "spans": len(spans),
+            "offset_us": round(offset_us, 1),
+            "host": host,
+        }
+    flows = _flow_events(attempt_events, request_events)
+    events.extend(flows)
+    summary["flow_events"] = len(flows) // 2
     meta = {
-        "merged_from": len(streams) + len(serve_streams),
+        "merged_from": len(streams) + len(serve_streams) + len(router_streams),
         "serve_replicas": sorted(serve_streams),
+        "routers": sorted(router_streams),
+        "flow_pairs": len(flows) // 2,
         "clock_origin_wall": origin,
         "unanchored_processes": summary["unanchored"],
     }
@@ -191,6 +333,160 @@ def merge_traces(workdir: str, output: str) -> dict:
         )
     summary["output"] = output
     return summary
+
+
+# router stage spans -> the stitched record's `router` section keys
+# (span names, not metric keys — derived so the metric-schema pass
+# doesn't read the table as a payload emission)
+_ROUTER_STAGE_KEYS = {
+    "router/" + stage: stage + "_ms"
+    for stage in ("ingress", "admission", "respond")
+}
+
+
+def stitch_traces(workdir: str) -> dict[str, dict]:
+    """Join the router + replica span streams by trace id into one
+    obs/critpath.py stitched-trace record per request (see that module's
+    docstring for the schema) — the OFFLINE twin of the router's in-band
+    stitching, built purely from the on-disk artifacts.
+
+    Clock alignment is the heartbeat-anchor correction merge_traces
+    applies: every timestamp shifts into one wall origin, so the network
+    split (`net_send_ms`/`net_recv_ms`) falls out of the aligned gap
+    between a router attempt span and the replica request span it
+    dispatched (joined on the propagated span id). Attempts whose
+    replica stream never recorded a request span (the replica died, or
+    the attempt failed before dispatch completed) keep `remote: None` —
+    the stitch is partial, not absent.
+
+    Returns {trace_id: stitched record}."""
+    router_streams = discover_router_streams(workdir)
+    serve_streams = discover_serve_streams(workdir)
+    router_beats = read_router_anchors(workdir)
+    serve_beats = read_serve_anchors(workdir)
+    router_anchors = {
+        r: rec["trace_wall_t0"]
+        for r, rec in router_beats.items()
+        if isinstance(rec.get("trace_wall_t0"), (int, float))
+    }
+    serve_anchors = {
+        r: rec["trace_wall_t0"]
+        for r, rec in serve_beats.items()
+        if isinstance(rec.get("trace_wall_t0"), (int, float))
+    }
+    all_anchors = list(router_anchors.values()) + list(serve_anchors.values())
+    origin = min(all_anchors) if all_anchors else 0.0
+
+    # -- replica side: request spans keyed by the propagated parent span
+    remote_by_parent: dict[str, dict] = {}
+    for r in sorted(serve_streams):
+        offset_us = (serve_anchors[r] - origin) * 1e6 if r in serve_anchors else 0.0
+        reqs: dict[str, tuple] = {}
+        stage_spans: dict[str, list] = {}
+        for s in read_spans(serve_streams[r]):
+            args = s.get("args") or {}
+            ts = float(s.get("ts") or 0.0) + offset_us
+            name = s.get("name") or ""
+            if name == "request" and args.get("parent_span"):
+                reqs[args.get("request_id")] = (ts, float(s.get("dur") or 0.0), args)
+            elif name.startswith("req/") and args.get("request_id"):
+                stage_spans.setdefault(args["request_id"], []).append(
+                    (name[len("req/"):], ts, float(s.get("dur") or 0.0))
+                )
+        for rid, (ts, dur, args) in reqs.items():
+            remote_by_parent[args["parent_span"]] = {
+                "request_id": rid,
+                "replica": r,
+                "span_id": args.get("span_id"),
+                "ts_us": ts,
+                "total_ms": dur / 1e3,
+                "stages": [
+                    {
+                        "stage": stage,
+                        "start_ms": round((sts - ts) / 1e3, 3),
+                        "dur_ms": round(sdur / 1e3, 3),
+                    }
+                    for stage, sts, sdur in sorted(
+                        stage_spans.get(rid, ()), key=lambda x: x[1]
+                    )
+                ],
+            }
+
+    # -- router side: one stitched record per request span, attempts
+    #    joined to the replica requests they dispatched
+    stitched: dict[str, dict] = {}
+    for i in sorted(router_streams):
+        offset_us = (router_anchors[i] - origin) * 1e6 if i in router_anchors else 0.0
+        reqs = {}
+        stage_ms: dict[str, dict] = {}
+        attempt_spans: dict[str, list] = {}
+        for s in read_spans(router_streams[i]):
+            args = s.get("args") or {}
+            trace_id = args.get("trace_id")
+            if not trace_id:
+                continue
+            ts = float(s.get("ts") or 0.0) + offset_us
+            dur = float(s.get("dur") or 0.0)
+            name = s.get("name")
+            if name == "request":
+                reqs[trace_id] = (ts, dur, args)
+            elif name in _ROUTER_STAGE_KEYS:
+                key = _ROUTER_STAGE_KEYS[name]
+                d = stage_ms.setdefault(trace_id, {})
+                d[key] = d.get(key, 0.0) + dur / 1e3
+            elif name == "router/attempt":
+                attempt_spans.setdefault(trace_id, []).append((ts, dur, args))
+        for trace_id, (ts, dur, args) in reqs.items():
+            attempts = []
+            for ats, adur, aargs in sorted(
+                attempt_spans.get(trace_id, ()), key=lambda x: x[0]
+            ):
+                att = {
+                    "span_id": aargs.get("span_id"),
+                    "replica": aargs.get("replica"),
+                    "retry_index": aargs.get("retry_index"),
+                    "lane": aargs.get("lane"),
+                    "breaker": aargs.get("breaker"),
+                    "outcome": aargs.get("outcome"),
+                    "winner": bool(aargs.get("winner")),
+                    "start_ms": round((ats - ts) / 1e3, 3),
+                    "dur_ms": round(adur / 1e3, 3),
+                    "net_send_ms": None,
+                    "net_recv_ms": None,
+                    "wasted_ms": aargs.get("wasted_ms"),
+                    "error": aargs.get("error"),
+                    "remote": None,
+                }
+                remote = remote_by_parent.get(att["span_id"])
+                if remote is not None:
+                    # clock-aligned network split: dispatch-to-replica-
+                    # ingress gap is send, the attempt's tail past the
+                    # replica's own wall is receive
+                    send = max(0.0, (remote["ts_us"] - ats) / 1e3)
+                    recv = max(0.0, adur / 1e3 - send - remote["total_ms"])
+                    att["net_send_ms"] = round(send, 3)
+                    att["net_recv_ms"] = round(recv, 3)
+                    att["remote"] = {
+                        "request_id": remote["request_id"],
+                        "replica": remote["replica"],
+                        "span_id": remote["span_id"],
+                        "stages": remote["stages"],
+                    }
+                attempts.append(att)
+            rounded = {
+                k: round(v, 3) for k, v in stage_ms.get(trace_id, {}).items()
+            }
+            stitched[trace_id] = {
+                "trace_id": trace_id,
+                "request_id": args.get("request_id"),
+                "path": args.get("path"),
+                "status": args.get("status"),
+                "wall_t0": origin + ts / 1e6,
+                "total_ms": round(dur / 1e3, 3),
+                "router": rounded,
+                "attempts": attempts,
+            }
+    return stitched
 
 
 def main() -> int:
@@ -219,6 +515,14 @@ def main() -> int:
             f"serve replica {r} (pid {SERVE_PID_BASE + r}): {info['spans']} "
             f"spans, clock offset {info['offset_us'] / 1e3:.1f} ms{host}"
         )
+    for r, info in sorted(summary.get("routers", {}).items()):
+        host = f" host={info['host']}" if info["host"] else ""
+        print(
+            f"fleet router {r} (pid {ROUTER_PID_BASE + r}): {info['spans']} "
+            f"spans, clock offset {info['offset_us'] / 1e3:.1f} ms{host}"
+        )
+    if summary.get("flow_events"):
+        print(f"linked {summary['flow_events']} router attempt -> replica request flows")
     if summary["unanchored"]:
         print(
             f"warning: no heartbeat clock anchor for processes "

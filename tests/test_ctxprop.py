@@ -1,0 +1,74 @@
+"""Trace-context propagation (ISSUE 18): id minting, header
+inject/extract round-trips, malformed-input degradation, and the
+contract-coverage hook the lint recorder listens on."""
+
+import pytest
+
+from moco_tpu.obs import ctxprop
+
+
+def test_id_minting_shapes_and_uniqueness():
+    tids = {ctxprop.new_trace_id() for _ in range(64)}
+    sids = {ctxprop.new_span_id() for _ in range(64)}
+    assert len(tids) == 64 and len(sids) == 64
+    for t in tids:
+        assert len(t) == ctxprop.TRACE_ID_HEX_LEN
+        int(t, 16)  # pure hex
+    for s in sids:
+        assert len(s) == ctxprop.SPAN_ID_HEX_LEN
+        int(s, 16)
+
+
+def test_inject_extract_round_trip():
+    ctx = ctxprop.TraceContext(ctxprop.new_trace_id(), ctxprop.new_span_id())
+    headers: dict = {}
+    ctxprop.inject(headers, ctx)
+    assert headers[ctxprop.TRACE_ID_HEADER] == ctx.trace_id
+    assert headers[ctxprop.PARENT_SPAN_HEADER] == ctx.span_id
+    back = ctxprop.extract(headers)
+    assert back is not None
+    assert back.trace_id == ctx.trace_id and back.span_id == ctx.span_id
+
+
+@pytest.mark.parametrize(
+    "trace_id",
+    [None, "", "zz" * 16, "abc", "a" * 33, "A" * 32 + "g"],
+)
+def test_parse_rejects_malformed_trace_id(trace_id):
+    assert ctxprop.parse(trace_id, "ab" * 8) is None
+
+
+def test_parse_degrades_malformed_parent_to_parentless():
+    tid = ctxprop.new_trace_id()
+    ctx = ctxprop.parse(tid, "not-hex")
+    assert ctx is not None and ctx.trace_id == tid and ctx.span_id is None
+    ctx2 = ctxprop.parse(tid, None)
+    assert ctx2 is not None and ctx2.span_id is None
+
+
+def test_coverage_callback_sees_both_headers():
+    seen = []
+    ctxprop.set_coverage_callback(seen.append)
+    try:
+        ctx = ctxprop.TraceContext(ctxprop.new_trace_id(), ctxprop.new_span_id())
+        ctxprop.inject({}, ctx)
+        ctxprop.parse(ctx.trace_id, ctx.span_id)
+        assert ctxprop.TRACE_ID_HEADER in seen
+        assert ctxprop.PARENT_SPAN_HEADER in seen
+    finally:
+        ctxprop.set_coverage_callback(None)
+    # cleared: no further recording
+    n = len(seen)
+    ctxprop.inject({}, ctx)
+    assert len(seen) == n
+
+
+def test_headers_registered_in_contract_registry():
+    from moco_tpu.utils import contracts
+
+    assert contracts.TRACE_HEADERS == (
+        ctxprop.TRACE_ID_HEADER,
+        ctxprop.PARENT_SPAN_HEADER,
+    )
+    for path in ("/embed", "/neighbors"):
+        assert contracts.OPTIONAL_HEADERS[path] == contracts.TRACE_HEADERS

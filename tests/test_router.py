@@ -21,6 +21,7 @@ import urllib.request
 import numpy as np
 import pytest
 
+from moco_tpu.obs import ctxprop
 from moco_tpu.serve.batcher import BatcherClosedError, ContinuousBatcher
 from moco_tpu.serve.fleet import ReplicaSupervisor, free_port
 from moco_tpu.serve.router import (
@@ -125,6 +126,7 @@ class FakeReplica:
         self.latency_s = latency_s
         self.fail_next = 0
         self.requests = 0
+        self.traced = 0
         self.ingested = 0
         self.draining = False
         self.stats_extra: dict = {}
@@ -151,6 +153,8 @@ class FakeReplica:
                 path = self.path.split("?")[0]
                 body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
                 if path in ("/embed", "/neighbors"):
+                    t_wall0 = time.time()
+                    t0 = time.perf_counter()
                     with outer._lock:
                         outer.requests += 1
                         seq = outer.requests
@@ -163,10 +167,29 @@ class FakeReplica:
                         return
                     if latency:
                         time.sleep(latency)
-                    self._json(200, {
-                        "request_id": f"r{outer.index}-{seq:06d}",
-                        "rows": 0, "embeddings": [],
-                    })
+                    rid = f"r{outer.index}-{seq:06d}"
+                    out = {"request_id": rid, "rows": 0, "embeddings": []}
+                    # in-band trace echo, like ServeServer: a propagated
+                    # context comes back as the replica-side waterfall
+                    trace_id = self.headers.get("X-Trace-Id")
+                    parent = self.headers.get("X-Parent-Span")
+                    if trace_id:
+                        with outer._lock:
+                            outer.traced += 1
+                        dt = (time.perf_counter() - t0) * 1e3
+                        out["trace"] = {
+                            "request_id": rid, "replica": outer.index,
+                            "rows": 0, "wall_t0": t_wall0,
+                            "total_ms": round(dt, 3),
+                            "trace_id": trace_id,
+                            "span_id": ctxprop.new_span_id(),
+                            "parent_span": parent,
+                            "stages": [{
+                                "stage": "engine_execute",
+                                "start_ms": 0.0, "dur_ms": round(dt, 3),
+                            }],
+                        }
+                    self._json(200, out)
                 elif path == "/ingest":
                     shape = self.headers.get("X-Rows-Shape", "0,0").split(",")
                     with outer._lock:
@@ -725,6 +748,290 @@ def test_supervisor_respawns_crashed_child_and_rewarms(tmp_path):
         sup.close()
     for child in sup._children:
         assert child.proc.poll() is not None  # everything reaped
+
+
+# -- distributed tracing (ISSUE 18) --------------------------------------
+
+
+def _flight_requests(url: str) -> list:
+    """Drain + snapshot the router's fleet flight ring."""
+    return _get(url, "/debug/flight")["requests"]
+
+
+def test_trace_stitches_failed_and_winning_attempts(fleet):
+    router, fakes = fleet
+    url = f"http://127.0.0.1:{router.port}"
+    # first attempt fails WHEREVER it lands; the retry's sibling succeeds
+    fakes[0].set(fail_next=1)
+    fakes[1].set(fail_next=1)
+    status, body = _post(url)
+    assert status == 200
+    assert ctxprop.parse(body.get("trace_id")) is not None  # well-formed id
+    recs = [r for r in _flight_requests(url) if r["trace_id"] == body["trace_id"]]
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["status"] == 200 and rec["request_id"] == body["request_id"]
+    outcomes = [(a["outcome"], a["winner"]) for a in rec["attempts"]]
+    assert ("failed", False) in outcomes and ("ok", True) in outcomes
+    failed = next(a for a in rec["attempts"] if a["outcome"] == "failed")
+    winner = next(a for a in rec["attempts"] if a["winner"])
+    # the retry is a distinct round of the SAME trace
+    assert failed["retry_index"] < winner["retry_index"]
+    assert failed["error"]
+    # the winning attempt stitched the replica's in-band waterfall in
+    assert winner["remote"]["request_id"] == body["request_id"]
+    assert any(
+        s["stage"] == "engine_execute" for s in winner["remote"]["stages"]
+    )
+    assert winner["net_send_ms"] is not None and winner["net_recv_ms"] is not None
+    # critical-path attribution lands in the metrics line, schema-clean
+    from moco_tpu.obs import schema
+
+    stats = router.stats()
+    assert stats["fleet_serve/critpath_retry_failed_ms"] > 0
+    assert schema.validate_line({"step": 1, "time": 0.0, **stats}) == []
+
+
+def test_hedge_loser_cancelled_with_wasted_ms_and_pure_p99():
+    fakes = [FakeReplica(0, latency_s=1.5), FakeReplica(1)]
+    router = FleetRouter(
+        replica_urls=[f.url for f in fakes],
+        slo_ms=1000.0,
+        health_interval_s=0.1,
+        hedge=True,
+        hedge_min_ms=100.0,
+        retry_base_delay_s=0.01,
+    )
+    url = f"http://127.0.0.1:{router.port}"
+    try:
+        status, body = _post(url)
+        assert status == 200 and body["replica"] == 1
+        # drain-under-load holdback: the loser lane is still in flight,
+        # so the trace is HELD rather than emitted with a pending lane
+        assert _flight_requests(url) == []
+        deadline = time.monotonic() + 10.0
+        recs = []
+        while time.monotonic() < deadline:
+            recs = [
+                r for r in _flight_requests(url)
+                if r["trace_id"] == body["trace_id"]
+            ]
+            if recs:
+                break
+            time.sleep(0.1)
+        assert len(recs) == 1, "held-back trace never emitted"
+        rec = recs[0]
+        winner = next(a for a in rec["attempts"] if a["winner"])
+        loser = next(a for a in rec["attempts"] if not a["winner"])
+        assert winner["lane"] == "hedge" and winner["replica"] == 1
+        assert loser["outcome"] == "cancelled"
+        assert loser["wasted_ms"] >= 1000.0  # the slow lane's real cost
+        # the cancelled lane shows up in the flattened waterfall too
+        assert any(
+            s["stage"] == "cancelled_hedge_r0" for s in rec["stages"]
+        )
+        stats = router.stats()
+        assert stats["fleet_serve/hedge_wasted_ms"] >= 1000.0
+        # p99 purity: only the CLIENT-OBSERVED latency entered the
+        # histogram — the discarded 1.5s lane must not poison it
+        assert stats["fleet_serve/p99_ms"] < 1200.0
+    finally:
+        router.close()
+        for f in fakes:
+            f.close()
+
+
+def test_burst_hop_sum_matches_client_wall(fleet):
+    from moco_tpu.obs import critpath
+
+    router, fakes = fleet
+    url = f"http://127.0.0.1:{router.port}"
+    fakes[0].set(latency_s=0.05)
+    fakes[1].set(latency_s=0.05)
+    walls = {}
+    lock = threading.Lock()
+
+    def worker():
+        for _ in range(3):
+            t0 = time.perf_counter()
+            status, body = _post(url)
+            wall_ms = (time.perf_counter() - t0) * 1e3
+            assert status == 200
+            with lock:
+                walls[body["trace_id"]] = wall_ms
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    recs = {r["trace_id"]: r for r in _flight_requests(url)}
+    assert set(walls) <= set(recs), "some traces never reached the flight ring"
+    for trace_id, wall_ms in walls.items():
+        attr = critpath.attribute(recs[trace_id])
+        ssum = sum(attr["hops"].values())
+        # hop sum == router total BY CONSTRUCTION...
+        assert ssum == pytest.approx(attr["total_ms"], abs=0.01)
+        # ...and the router total accounts for the client's wall (floor
+        # widened vs the smoke's gate: these requests are ~50ms, where
+        # one slow TCP setup is a visible fraction)
+        assert abs(ssum - wall_ms) <= max(0.15 * wall_ms, 50.0), (
+            f"{trace_id}: hops {ssum:.1f}ms vs wall {wall_ms:.1f}ms"
+        )
+        # every replica served through the front door echoed a waterfall
+        assert any(h.startswith("replica_") for h in attr["hops"])
+
+
+def test_router_workdir_emits_stream_anchor_and_flight_dump(tmp_path):
+    from moco_tpu.obs.flight import read_flight_dumps
+
+    fakes = [FakeReplica(0)]
+    router = FleetRouter(
+        replica_urls=[fakes[0].url],
+        slo_ms=1000.0,
+        health_interval_s=0.1,
+        hedge=False,
+        workdir=str(tmp_path),
+    )
+    url = f"http://127.0.0.1:{router.port}"
+    try:
+        for _ in range(3):
+            _post(url)
+        body = _get(url, "/debug/flight")
+        assert body["requests_recorded"] >= 3
+        assert body["dump_path"] and os.path.exists(body["dump_path"])
+    finally:
+        router.close()
+        for f in fakes:
+            f.close()
+    # the on-demand dump is a readable flight artifact with router role
+    dumps = read_flight_dumps(str(tmp_path))
+    assert dumps and dumps[-1][1]["role"] == "router"
+    # the Perfetto stream + clock anchor landed for trace_merge
+    anchor = json.load(open(tmp_path / "heartbeat.r0.json"))
+    assert anchor["role"] == "router" and anchor["trace_wall_t0"] > 0
+    spans = [
+        json.loads(line)
+        for line in open(tmp_path / "trace_events.r0.jsonl")
+        if line.strip()
+    ]
+    names = {s["name"] for s in spans}
+    assert {"request", "router/attempt", "router/respond"} <= names
+    # every attempt span carries the propagated ids the stitcher joins on
+    for s in spans:
+        if s["name"] == "router/attempt":
+            assert ctxprop.parse(s["args"]["trace_id"]) is not None
+            assert len(s["args"]["span_id"]) == ctxprop.SPAN_ID_HEX_LEN
+
+
+def test_trace_disabled_router_serves_untraced():
+    fakes = [FakeReplica(0)]
+    router = FleetRouter(
+        replica_urls=[fakes[0].url],
+        slo_ms=1000.0,
+        health_interval_s=0.1,
+        hedge=False,
+        reqtrace=False,
+    )
+    url = f"http://127.0.0.1:{router.port}"
+    try:
+        status, body = _post(url)
+        assert status == 200 and "trace_id" not in body
+        assert _flight_requests(url) == []
+    finally:
+        router.close()
+        for f in fakes:
+            f.close()
+
+
+# -- trace_merge: the router joins the fleet timeline ---------------------
+
+
+def _write_jsonl(path, records):
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_trace_merge_router_track_flow_events_and_offline_stitch(tmp_path):
+    tm = load_script("trace_merge.py")
+    wd = str(tmp_path)
+    trace_id = "ab" * 16
+    attempt_span = "cd" * 8
+    # router 0: anchor wall 1000.0; one request with one attempt
+    _write_jsonl(os.path.join(wd, "trace_events.r0.jsonl"), [
+        {"name": "request", "ts": 0.0, "dur": 50_000.0, "tid": 1,
+         "thread": "requests-0", "p": 0,
+         "args": {"trace_id": trace_id, "span_id": "11" * 8,
+                  "path": "/embed", "status": 200,
+                  "request_id": "r1-000007"}},
+        {"name": "router/ingress", "ts": 0.0, "dur": 1_000.0, "tid": 1,
+         "thread": "requests-0", "p": 0, "args": {"trace_id": trace_id}},
+        {"name": "router/attempt", "ts": 2_000.0, "dur": 40_000.0, "tid": 1,
+         "thread": "requests-0", "p": 0,
+         "args": {"trace_id": trace_id, "span_id": attempt_span,
+                  "replica": 1, "retry_index": 0, "lane": "primary",
+                  "breaker": "closed", "outcome": "ok", "winner": True,
+                  "wasted_ms": 0.0, "error": None}},
+        {"name": "router/respond", "ts": 48_000.0, "dur": 2_000.0, "tid": 1,
+         "thread": "requests-0", "p": 0, "args": {"trace_id": trace_id}},
+    ])
+    with open(os.path.join(wd, "heartbeat.r0.json"), "w") as f:
+        json.dump({"process": 0, "role": "router", "host": "routerhost",
+                   "time": 1000.0, "trace_wall_t0": 1000.0}, f)
+    # replica 1 in a fleet-style subdir: clock starts 0.01s later; its
+    # request span parents under the router's attempt span
+    sub = tmp_path / "replica1"
+    sub.mkdir()
+    _write_jsonl(str(sub / "trace_events.s1.jsonl"), [
+        {"name": "request", "ts": 0.0, "dur": 30_000.0, "tid": 1,
+         "thread": "requests-0", "p": 1,
+         "args": {"request_id": "r1-000007", "rows": 1, "replica": 1,
+                  "trace_id": trace_id, "span_id": "22" * 8,
+                  "parent_span": attempt_span}},
+        {"name": "req/engine_execute", "ts": 5_000.0, "dur": 20_000.0,
+         "tid": 1, "thread": "requests-0", "p": 1,
+         "args": {"request_id": "r1-000007"}},
+    ])
+    with open(sub / "heartbeat.s1.json", "w") as f:
+        json.dump({"process": 1, "role": "serve", "host": "servehost",
+                   "time": 1000.01, "trace_wall_t0": 1000.01}, f)
+
+    out = os.path.join(wd, "merged.json")
+    summary = tm.merge_traces(wd, out)
+    assert summary["routers"][0]["spans"] == 4
+    assert summary["serve_replicas"][1]["offset_us"] == pytest.approx(10_000.0)
+    assert summary["flow_events"] == 1
+    merged = json.load(open(out))
+    flows = [e for e in merged["traceEvents"] if e.get("ph") in ("s", "f")]
+    start = next(e for e in flows if e["ph"] == "s")
+    finish = next(e for e in flows if e["ph"] == "f")
+    assert start["id"] == finish["id"] == attempt_span
+    assert start["pid"] == tm.ROUTER_PID_BASE
+    assert finish["pid"] == tm.SERVE_PID_BASE + 1
+    assert finish["bp"] == "e"
+    # the arrow points forward in the aligned clock
+    assert finish["ts"] > start["ts"]
+
+    stitched = tm.stitch_traces(wd)
+    assert set(stitched) == {trace_id}
+    rec = stitched[trace_id]
+    assert rec["total_ms"] == pytest.approx(50.0)
+    assert rec["router"]["ingress_ms"] == pytest.approx(1.0)
+    assert rec["router"]["respond_ms"] == pytest.approx(2.0)
+    (att,) = rec["attempts"]
+    assert att["winner"] and att["outcome"] == "ok"
+    # clock-aligned network split: replica ingress at wall +10ms, the
+    # attempt dispatched at +2ms -> 8ms send; 40 - 8 - 30 = 2ms recv
+    assert att["net_send_ms"] == pytest.approx(8.0)
+    assert att["net_recv_ms"] == pytest.approx(2.0)
+    assert att["remote"]["request_id"] == "r1-000007"
+    assert att["remote"]["stages"][0]["stage"] == "engine_execute"
+    # the stitched record feeds critpath cleanly: hop sum == total
+    from moco_tpu.obs import critpath
+
+    attr = critpath.attribute(rec)
+    assert sum(attr["hops"].values()) == pytest.approx(rec["total_ms"])
 
 
 # -- serve_ingest --fanout -----------------------------------------------

@@ -496,6 +496,51 @@ def test_obs_report_serving_section(tmp_path):
     assert "p99 exemplar" in report
 
 
+def test_obs_report_fleet_tracing_section(tmp_path):
+    rep = load_script("obs_report.py")
+    wd = str(tmp_path)
+    lines = []
+    for i in range(4):
+        lines.append({
+            "step": i + 1, "time": 100.0 + i,
+            "fleet_serve/requests": 20 * (i + 1),
+            "fleet_serve/slo_ms": 1000.0, "fleet_serve/p99_ms": 400.0,
+            "fleet_serve/hedges": 6, "fleet_serve/hedge_wins": 3,
+            "fleet_serve/hedge_wasted_ms": 1234.5,
+            "fleet_serve/retries": 2,
+            "fleet_serve/critpath_router_admission_ms": 1.0,
+            "fleet_serve/critpath_net_send_ms": 4.0,
+            "fleet_serve/critpath_replica_engine_execute_ms": 80.0,
+            "fleet_serve/critpath_retry_failed_ms": 12.0,
+            "fleet_serve/critpath_router_other_ms": 3.0,
+        })
+    with open(os.path.join(wd, "metrics.jsonl"), "w") as f:
+        for rec in lines:
+            f.write(json.dumps(rec) + "\n")
+    # a router flight dump with one stitched multi-hop waterfall
+    fr = FlightRecorder()
+    fr.record_request({
+        "trace_id": "ab" * 16, "request_id": "r2-000009", "status": 200,
+        "total_ms": 950.0,
+        "attempts": [{"outcome": "failed"}, {"outcome": "ok", "winner": True}],
+        "stages": [
+            {"stage": "router_admission", "start_ms": 0.0, "dur_ms": 1.0},
+            {"stage": "replica_engine_execute", "start_ms": 10.0, "dur_ms": 900.0},
+        ],
+    })
+    fr.dump(wd, reason="alert:slo_burn_fast", extra={"role": "router"})
+    report = rep.render_report(os.path.join(wd, "metrics.jsonl"), workdir=wd)
+    assert "## Fleet tracing" in report
+    assert "critical path" in report
+    assert "replica_engine_execute" in report
+    assert "win rate 50%" in report
+    assert "retries: 2" in report
+    assert "slowest distributed waterfalls" in report
+    assert "ab" * 16 in report and "r2-000009" in report
+    # the router dump must NOT leak into the per-replica Serving section
+    assert "slowest requests (flight recorder" not in report
+
+
 # -- end-to-end chaos: slow stage -> burn alert -> attributed dump ------
 
 
@@ -645,3 +690,17 @@ def test_perf_ledger_gates_trace_overhead(tmp_path):
     with open(cand, "w") as f:
         json.dump(legacy, f)
     assert pl.check(ledger, cand) == 0
+    # the router-side distributed-tracing A/B (ISSUE 18) gates under the
+    # same caps as the replica-side series
+    routed = dict(rec, serving=dict(
+        rec["serving"], router_trace_overhead_pct=3.0
+    ))
+    with open(cand, "w") as f:
+        json.dump(routed, f)
+    assert pl.check(ledger, cand) == 0
+    routed_bad = dict(rec, serving=dict(
+        rec["serving"], router_trace_overhead_pct=60.0
+    ))
+    with open(cand, "w") as f:
+        json.dump(routed_bad, f)
+    assert pl.check(ledger, cand) == 1
