@@ -692,6 +692,169 @@ def main() -> None:
                 else None
             )
 
+            # ---- promotion-swap overhead (ISSUE 19) ---------------------
+            # What one staged-rollout step costs the fleet front door:
+            # two in-process replicas behind a FleetRouter, closed-loop
+            # clients running throughout, and replica 0 promoted
+            # (drain -> swap -> re-admit with a new model identity).
+            # promote_pause_ms = wall time from promote_replica() until
+            # /admin/replicas shows the replica back (healthy, not
+            # draining, new digest); promote_swap_p99_ms = client p99 of
+            # requests overlapping that window; promote_swap_failures
+            # must be 0 (the drain path's whole point). The swap rebinds
+            # the same port around the already-warm engine, so the pause
+            # measures the router-side drain/readmit machinery and
+            # EXCLUDES checkpoint restore + AOT re-warm (the fleet smoke
+            # exercises the full cold swap). perf_ledger.py check gates
+            # all three fields.
+            promote_pause_ms = promote_swap_p99 = promote_failures = None
+            if not os.environ.get("BENCH_SKIP_PROMOTE"):
+                import urllib.request as _urlreq2
+
+                from moco_tpu.serve.router import FleetRouter
+                from moco_tpu.serve.server import ServeServer
+
+                class _SwapSupervisor:
+                    """Duck-typed ReplicaSupervisor stand-in: the
+                    router's promotion path only ever calls
+                    set_ckpt_dir() and restart_replica(). A restart
+                    rebuilds the in-process replica on the SAME port
+                    around the warm engine, bumping the model identity
+                    so the digest landing is observable."""
+
+                    def __init__(self, servers):
+                        self.servers = servers
+                        self.ckpt_dir = None
+
+                    def urls(self):
+                        return [
+                            f"http://127.0.0.1:{s.port}" for s in self.servers
+                        ]
+
+                    def set_ckpt_dir(self, path):
+                        self.ckpt_dir = str(path)
+
+                    def restart_replica(self, i):
+                        old = self.servers[i]
+                        port, step = old.port, (old.model_step or 0) + 1
+                        old.close()
+                        self.servers[i] = ServeServer(
+                            eng, index=index, port=port, slo_ms=slo_ms,
+                            neighbors_k=5, warmup=False, model_step=step,
+                            model_digest=f"benchswap{step:03d}",
+                        )
+
+                duck = _SwapSupervisor([
+                    ServeServer(
+                        eng, index=index, port=0, slo_ms=slo_ms,
+                        neighbors_k=5, warmup=False, model_step=0,
+                        model_digest=f"benchlive{i:03d}",
+                    )
+                    for i in range(2)
+                ])
+                prouter = FleetRouter(
+                    replica_urls=duck.urls(), supervisor=duck, port=0,
+                    slo_ms=slo_ms, hedge=False, health_interval_s=0.1,
+                )
+                pbase = f"http://127.0.0.1:{prouter.port}"
+                admitted = threading.Event()
+                stop_p = threading.Event()
+                p_lock = threading.Lock()
+                p_samples = []  # (t_start, t_end, ms) post-admission
+                p_failures = []
+
+                def pclient(ci: int) -> None:
+                    crng = np.random.default_rng(300 + ci)
+                    while not stop_p.is_set():
+                        n = int(crng.choice(sizes))
+                        req = _urlreq2.Request(
+                            pbase + "/embed",
+                            data=canned[n].tobytes(),
+                            headers={"X-Image-Shape": ",".join(
+                                map(str, canned[n].shape)
+                            )},
+                        )
+                        t0 = time.perf_counter()
+                        try:
+                            with _urlreq2.urlopen(req, timeout=30) as r:
+                                r.read()
+                        except Exception as e:
+                            if admitted.is_set():
+                                with p_lock:
+                                    p_failures.append(repr(e))
+                            else:
+                                # pre-admission 503s while the health
+                                # loop admits the replicas are expected
+                                time.sleep(0.05)
+                            continue
+                        t1 = time.perf_counter()
+                        if admitted.is_set():
+                            with p_lock:
+                                p_samples.append((t0, t1, (t1 - t0) * 1e3))
+
+                def _fleet_snap():
+                    with _urlreq2.urlopen(
+                        pbase + "/admin/replicas", timeout=5
+                    ) as r:
+                        return json.loads(r.read())["replicas"]
+
+                try:
+                    deadline = time.monotonic() + 30.0
+                    while time.monotonic() < deadline:
+                        snaps = _fleet_snap()
+                        if all(s["healthy"] and s["warm"] for s in snaps):
+                            break
+                        time.sleep(0.1)
+                    pclients = [
+                        threading.Thread(target=pclient, args=(i,), daemon=True)
+                        for i in range(4)
+                    ]
+                    for c in pclients:
+                        c.start()
+                    admitted.set()
+                    time.sleep(max(warm_s, 1.0))
+                    t_sw0 = time.perf_counter()
+                    if not prouter.promote_replica(0, "bench-candidate"):
+                        raise RuntimeError("promotion step refused: replica busy")
+                    deadline = time.monotonic() + 60.0
+                    landed = False
+                    while time.monotonic() < deadline:
+                        s0 = _fleet_snap()[0]
+                        if (
+                            s0["healthy"]
+                            and not s0["draining"]
+                            and s0["model_digest"] == "benchswap001"
+                        ):
+                            landed = True
+                            break
+                        time.sleep(0.05)
+                    t_sw1 = time.perf_counter()
+                    if not landed:
+                        raise RuntimeError(
+                            f"promotion swap never landed: {_fleet_snap()[0]}"
+                        )
+                    time.sleep(0.5)  # tail traffic past the swap window
+                    stop_p.set()
+                    for c in pclients:
+                        c.join(timeout=10.0)
+                finally:
+                    stop_p.set()
+                    prouter.close()
+                    for s in duck.servers:
+                        s.close()
+                promote_pause_ms = (t_sw1 - t_sw0) * 1e3
+                with p_lock:
+                    window = sorted(
+                        ms for (a, b, ms) in p_samples
+                        if b >= t_sw0 and a <= t_sw1
+                    )
+                    promote_failures = len(p_failures)
+                promote_swap_p99 = (
+                    window[min(len(window) - 1, int(len(window) * 0.99))]
+                    if window
+                    else None
+                )
+
             # ---- quantized-engine A/B (ISSUE 11): w8 vs w8a8 ----------
             # Same params, same buckets, same index; qps measured in
             # short INTERLEAVED slices (the tiers alternate inside one
@@ -850,6 +1013,22 @@ def main() -> None:
                     for k, v in payload_traced.items()
                     if k.startswith("serve/trace_") and k.endswith("_ms")
                 },
+                # promotion-swap overhead (ISSUE 19): one staged-rollout
+                # step through the router under live closed-loop load —
+                # the pause until the swapped replica re-admits with its
+                # new digest, the client p99 across the swap window, and
+                # the failure count (gated at 0 by perf_ledger.py check)
+                "promote_pause_ms": (
+                    round(promote_pause_ms, 2)
+                    if promote_pause_ms is not None
+                    else None
+                ),
+                "promote_swap_p99_ms": (
+                    round(promote_swap_p99, 2)
+                    if promote_swap_p99 is not None
+                    else None
+                ),
+                "promote_swap_failures": promote_failures,
                 # quantized-engine tiers (ISSUE 11): w8/w8a8 qps from the
                 # interleaved slices + embedding cosine vs f32 (gated at
                 # QUANT_COSINE_FLOOR by perf_ledger.py check), and
@@ -871,6 +1050,18 @@ def main() -> None:
                     f"router tracing A/B: {router_qps:.1f} q/s untraced, "
                     f"{router_qps_traced:.1f} q/s traced "
                     f"(overhead={router_trace_overhead_pct:+.1f}%)",
+                    file=sys.stderr,
+                )
+            if promote_pause_ms is not None:
+                print(
+                    f"promotion swap: pause={promote_pause_ms:.0f}ms "
+                    f"p99-during-swap="
+                    + (
+                        f"{promote_swap_p99:.0f}ms"
+                        if promote_swap_p99 is not None
+                        else "n/a"
+                    )
+                    + f" failures={promote_failures}",
                     file=sys.stderr,
                 )
         except Exception as e:

@@ -1,0 +1,167 @@
+"""Model-quality observability: encoder identity + compatibility scoring.
+
+The systems plane (PRs 3/4/10/18) made step time, comms, and request
+waterfalls legible; this module gives the *model* plane the same rails.
+MoCo's core invariant (He et al., arXiv:1911.05722) is that dictionary
+keys stay CONSISTENT with the slowly-evolving key encoder — when the
+serving index holds rows embedded by encoder A while queries are
+embedded by encoder B, recall degrades silently: no error, no 5xx,
+and (before this module) no gauge. The EMA-scaling analysis
+(arXiv:2307.13813) says the drift rate is a function of momentum and
+schedule, so "the checkpoints are close together" is not a safety
+argument — the compatibility of a candidate encoder with the LIVE
+index must be measured, per promotion, in embedding space.
+
+Three surfaces:
+
+- **identity** — `params_digest` (content hash of the encoder's
+  parameter pytree) + `model_payload` give every replica a stable
+  `serve/model_step` / `serve/model_digest` gauge pair, so version
+  skew across the fleet is a visible gauge instead of an incident.
+- **compatibility** — `score_compat` re-embeds a held-back probe set
+  under the candidate AND the live encoder: `compat_cosine` (mean
+  probe-wise cosine between the two embeddings — 1.0 means the
+  candidate moves nothing, a rotation/collapse drops it) and
+  `recall_overlap` (mean top-k id overlap when the same probes query
+  the same live index under both encoders — the retrieval-semantics
+  check `compat_cosine` alone can miss, reusing the index's existing
+  online-recall query machinery). `compat_payload` emits them as the
+  schema'd `serve/compat_cosine` / `serve/recall_overlap` gauges the
+  promotion ledger and obs_report read.
+- **probes** — `synthetic_probes` is the deterministic held-back probe
+  set for smokes/CLIs without a real eval split (seeded, so the live
+  and candidate sides always embed the SAME inputs).
+
+numpy-only on top of duck-typed engines (anything with
+`embed(images) -> (embeddings, executed)`) — unit tests drive it with
+fakes, the promotion pipeline with real `InferenceEngine`s.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Optional
+
+import numpy as np
+
+
+def _flat_leaves(tree, prefix=""):
+    """Depth-first (path, array) leaves of a nested-dict pytree, paths
+    sorted — a stable iteration order so the digest is deterministic
+    across processes and save/restore round-trips."""
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            yield from _flat_leaves(tree[k], f"{prefix}/{k}")
+    else:
+        yield prefix, np.asarray(tree)
+
+
+def params_digest(params, length: int = 12) -> str:
+    """Content hash (hex, `length` chars) of an encoder parameter
+    pytree: sha256 over every leaf's path, shape, dtype, and bytes.
+    Two replicas serving byte-identical weights agree; any retrain,
+    EMA tick, or corruption disagrees — the fleet's version-skew gauge
+    keys on this, not on step numbers (which collide across workdirs)."""
+    h = hashlib.sha256()
+    for path, leaf in _flat_leaves(params):
+        h.update(path.encode())
+        h.update(str(leaf.shape).encode())
+        h.update(str(leaf.dtype).encode())
+        h.update(np.ascontiguousarray(leaf).tobytes())
+    return h.hexdigest()[:length]
+
+
+def model_payload(step: Optional[int], digest: Optional[str]) -> dict:
+    """The served-model identity gauges, schema'd (obs/schema.py):
+    `serve/model_step` (checkpoint step the encoder came from, null
+    when unknown) and `serve/model_digest` (params content hash)."""
+    return {
+        "serve/model_step": int(step) if step is not None else None,
+        "serve/model_digest": str(digest) if digest is not None else None,
+    }
+
+
+def compat_cosine(live_emb, cand_emb) -> float:
+    """Mean probe-wise cosine between the live and candidate encoders'
+    embeddings of the SAME probes (both already L2-normalized, (n, d)).
+    1.0 = the candidate moves nothing; an orthogonal rotation of the
+    head scores ~0 even though every self-similarity looks healthy."""
+    a = np.asarray(live_emb, np.float32)
+    b = np.asarray(cand_emb, np.float32)
+    if a.shape != b.shape or a.ndim != 2:
+        raise ValueError(f"embedding shapes differ: {a.shape} vs {b.shape}")
+    return float(np.mean(np.sum(a * b, axis=1)))
+
+
+def recall_overlap(live_emb, cand_emb, index, k: int = 5, mode: str = "exact") -> float:
+    """Mean top-k id overlap when the same probes query the SAME live
+    index under the live vs candidate encoder — the retrieval-semantics
+    compatibility check: the candidate may keep high cosine yet reorder
+    the neighborhood structure the index rows were built for."""
+    k = int(min(int(k), index.count))
+    if k < 1:
+        raise ValueError("recall_overlap needs a non-empty index")
+    _, live_ids = index.query(np.asarray(live_emb, np.float32), k, mode=mode)
+    _, cand_ids = index.query(np.asarray(cand_emb, np.float32), k, mode=mode)
+    per_probe = [
+        len(set(int(i) for i in l) & set(int(i) for i in c)) / k
+        for l, c in zip(live_ids, cand_ids)
+    ]
+    return float(np.mean(per_probe))
+
+
+def compat_payload(cosine: Optional[float], overlap: Optional[float]) -> dict:
+    """The compatibility drift gauges, schema'd (obs/schema.py):
+    `serve/compat_cosine` in [-1, 1], `serve/recall_overlap` in [0, 1]
+    (null where the index was empty / the check did not run)."""
+    return {
+        "serve/compat_cosine": float(cosine) if cosine is not None else None,
+        "serve/recall_overlap": float(overlap) if overlap is not None else None,
+    }
+
+
+def score_compat(
+    live_engine,
+    cand_engine,
+    probes,
+    index=None,
+    k: int = 5,
+    mode: str = "exact",
+) -> dict:
+    """Run the full compatibility scorer: re-embed `probes` under both
+    engines, return `{"cosine", "overlap", "n_probes", "k"}` (overlap
+    null without a usable index). The promotion gate battery thresholds
+    these against its declared floors."""
+    probes = np.asarray(probes)
+    live_emb, _ = live_engine.embed(probes)
+    cand_emb, _ = cand_engine.embed(probes)
+    out = {
+        "cosine": compat_cosine(live_emb, cand_emb),
+        "overlap": None,
+        "n_probes": int(probes.shape[0]),
+        "k": int(k),
+    }
+    if index is not None and index.count > 0:
+        out["overlap"] = recall_overlap(live_emb, cand_emb, index, k=k, mode=mode)
+    return out
+
+
+def synthetic_probes(n: int = 32, image_size: int = 32, seed: int = 0) -> np.ndarray:
+    """Deterministic held-back probe images ((n, s, s, 3) uint8 — the
+    engine's wire format) for smokes and CLIs without a real eval
+    split — seeded so every gate evaluation embeds the same inputs."""
+    rng = np.random.RandomState(seed)
+    return rng.randint(
+        0, 256, (int(n), int(image_size), int(image_size), 3)
+    ).astype(np.uint8)
+
+
+__all__ = [
+    "compat_cosine",
+    "compat_payload",
+    "model_payload",
+    "params_digest",
+    "recall_overlap",
+    "score_compat",
+    "synthetic_probes",
+]

@@ -51,7 +51,11 @@ EVENT_KINDS = frozenset(
      # the elastic checkpoint-and-rescale (parallel/elastic.py): the
      # rescale line carries the rescale/* family below — old/new mesh
      # shape, old/new global batch, and the re-derived hyperparameters
-     "preempt", "rescale"}
+     "preempt", "rescale",
+     # checkpoint-promotion audit lines (serve/promote.py
+     # PromotionLedger): verdict + per-gate evidence in the promotion/*
+     # family below
+     "promotion"}
 )
 
 TRAIN_REQUIRED = ("epoch", "lr", "loss", "acc1", "acc5")
@@ -203,6 +207,27 @@ FIELD_VALIDATORS = {
     "serve/p99_exemplar_ms": _nonneg_or_null,
     "serve/slo_objective": lambda v: _num(v) and 0.0 < v < 1.0,
     "serve/trace_overhead_pct": _num_or_null,
+    # served-model identity (obs/quality.py): the checkpoint step the
+    # live encoder came from (null when unknown — e.g. a hand-built
+    # engine), its params content digest (a STRING, exempted from the
+    # numeric serve/ family), and the checkpoint step of the last
+    # /ingest block (X-Ckpt-Step; null until a tailer reports one)
+    "serve/model_step": lambda v: v is None or _int_like(v),
+    "serve/model_digest": _str_or_null,
+    "serve/ingest_ckpt_step": lambda v: v is None or _int_like(v),
+    # freshness SLO (obs/slo.py FreshnessBurnTracker + index row
+    # stamps): wall-clock age of the oldest/mean stamped index row
+    # (null while the index has no stamped rows) and the declared
+    # max-age objective (strictly positive — a replica without a
+    # freshness objective omits the whole family)
+    "serve/row_age_max_s": _nonneg_or_null,
+    "serve/row_age_mean_s": _nonneg_or_null,
+    "serve/fresh_max_age_s": lambda v: _num(v) and v > 0,
+    # embedding-space compatibility gauges (obs/quality.py): mean
+    # probe cosine between live and candidate encoders, and top-k
+    # neighbor overlap against the live index (null = not measured)
+    "serve/compat_cosine": lambda v: v is None or (_num(v) and -1.0 <= v <= 1.0),
+    "serve/recall_overlap": lambda v: v is None or (_num(v) and 0.0 <= v <= 1.0),
     # elastic rescale event lines (parallel/elastic.py): the lost host
     # indices (list of ints) ride the otherwise-numeric rescale/ family
     "rescale/dead_hosts": _num_list,
@@ -221,6 +246,23 @@ FIELD_VALIDATORS = {
     # cumulative cost of cancelled hedge lanes (serve/router.py hedge-
     # loser accounting) — a counter in ms, never negative
     "fleet_serve/hedge_wasted_ms": _nonneg_or_null,
+    # fleet version skew (serve/router.py stats): distinct served model
+    # digests minus one — 0 homogeneous, >0 mid-rollout; null until any
+    # replica reports a digest
+    "fleet_serve/model_skew": lambda v: v is None or (_int_like(v) and v >= 0),
+    # promotion audit lines (serve/promote.py ledger_record): the
+    # verdict enum, the pipeline stage, the candidate's params digest,
+    # the first failed gate (null on success), and which replica a
+    # rollout event refers to (null for fleet-wide lines). Per-gate
+    # evidence rides the numeric promotion/ prefix family below.
+    "promotion/verdict": lambda v: v in (
+        "accepted", "rejected", "promoted", "rolled_back"
+    ),
+    "promotion/stage": lambda v: isinstance(v, str),
+    "promotion/digest": _str_or_null,
+    "promotion/failed_gate": _str_or_null,
+    "promotion/replica": lambda v: v is None or _int_like(v),
+    "promotion/step": _int_like,
     # alert event lines (obs/alerts.py)
     "alert": lambda v: isinstance(v, str),
     "severity": lambda v: v in ("warn", "fatal"),
@@ -249,6 +291,9 @@ PREFIX_VALIDATORS = {
     # matching prefix wins (see validate_line), so these shadow serve/.
     "serve/trace_": _nonneg_or_null,
     "serve/burn_rate_": _nonneg_or_null,
+    # the freshness-SLO burn twin (obs/slo.py FreshnessBurnTracker
+    # payload) — same null-while-empty / never-negative contract
+    "serve/fresh_burn_rate_": _nonneg_or_null,
     # the fleet-router family (serve/router.py): latency gauges null
     # before the first proxied request, counters numeric; the burn
     # sub-family (router client-observed + per-replica min/mean/max
@@ -259,10 +304,19 @@ PREFIX_VALIDATORS = {
     # so no literal emission exists for JX015 to see; the runtime
     # contract-coverage gate proves the family live instead
     "fleet_serve/burn_rate_": _nonneg_or_null,  # mocolint: disable=JX015
+    # the freshness burn aggregates ride the same dynamic rename, so
+    # the same no-literal-emission exemption applies
+    "fleet_serve/fresh_burn_rate_": _nonneg_or_null,  # mocolint: disable=JX015
     # critical-path hop attribution (obs/critpath.py metrics_payload):
     # mean ms on the request critical path per hop — never negative,
     # null while the aggregation window is empty
     "fleet_serve/critpath_": _nonneg_or_null,
+    # promotion-ledger per-gate evidence (serve/promote.py):
+    # promotion/gate/<name> measured value (null where a gate could not
+    # run), promotion/floor/<name> declared threshold,
+    # promotion/gate_ok/<name> 0/1 — the explicit entries above
+    # (verdict/stage/digest/...) take precedence over this family
+    "promotion/": _num_or_null,
 }
 
 

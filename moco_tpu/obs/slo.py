@@ -112,6 +112,57 @@ class SLOBurnTracker:
         return out
 
 
+class FreshnessBurnTracker:
+    """Burn-rate accounting for the serving FRESHNESS SLO: the declared
+    objective is "at least `objective` of freshness observations see a
+    max index-row age <= `max_age_s` wall-seconds". Each metrics flush
+    records one observation (the flusher samples
+    `EmbeddingIndex.row_age_stats()`), so a stalled ingest pipeline
+    burns budget at exactly the flush cadence and the same multi-window
+    threshold rules that page on latency burn page on staleness.
+
+    The bucket math is `SLOBurnTracker`'s (composition, not a copy):
+    per-second good/bad buckets, bounded memory, deterministic `now`
+    for unit tests. The payload family is `serve/fresh_burn_rate_<w>s`
+    plus the declared `serve/fresh_max_age_s` objective gauge; the
+    router renames per-replica gauges into `fleet_serve/fresh_burn_*`
+    aggregates exactly as it does for the latency family."""
+
+    def __init__(
+        self,
+        max_age_s: float,
+        objective: float = 0.99,
+        windows: Sequence[int] = DEFAULT_WINDOWS,
+    ):
+        if not max_age_s > 0:
+            raise ValueError(f"max_age_s must be > 0, got {max_age_s}")
+        self.max_age_s = float(max_age_s)
+        self._burn = SLOBurnTracker(
+            slo_ms=self.max_age_s * 1e3, objective=objective, windows=windows
+        )
+        self.objective = self._burn.objective
+        self.windows = self._burn.windows
+
+    def record(self, row_age_s: Optional[float], now: Optional[float] = None) -> None:
+        """One freshness observation: the index's current max row age
+        (None = no stamped rows yet — an empty index is not stale)."""
+        ok = row_age_s is None or float(row_age_s) <= self.max_age_s
+        self._burn.record(ok, now=now)
+
+    def burn_rates(self, now: Optional[float] = None) -> dict[int, Optional[float]]:
+        return self._burn.burn_rates(now)
+
+    def payload(self, now: Optional[float] = None) -> dict:
+        """The schema'd `serve/fresh_burn_rate_<w>s` gauge family plus
+        the declared max-age objective — merged into the serve flush."""
+        out = {
+            f"serve/fresh_burn_rate_{w}s": rate
+            for w, rate in self.burn_rates(now).items()
+        }
+        out["serve/fresh_max_age_s"] = self.max_age_s
+        return out
+
+
 def serve_alert_spec(
     slo_ms: Optional[float] = None,
     windows: Sequence[int] = DEFAULT_WINDOWS,
@@ -145,10 +196,36 @@ def serve_alert_spec(
     return ",".join(rules)
 
 
+def fresh_alert_spec(
+    windows: Sequence[int] = DEFAULT_WINDOWS,
+    fast_burn: float = DEFAULT_FAST_BURN,
+    slow_burn: float = DEFAULT_SLOW_BURN,
+    prefix: str = "serve",
+) -> str:
+    """The freshness-SLO default alert rules — the same multiwindow
+    threshold pair as `serve_alert_spec`, over the
+    `<prefix>/fresh_burn_rate_<w>s` family. A replica with a freshness
+    objective appends these to its serving rules; the fleet smoke's
+    ingest-stall leg (`delay@site=ingest`) proves they fire."""
+    windows = tuple(sorted(int(w) for w in windows))
+    rules = [
+        f"threshold@name=fresh_burn_fast:field={prefix}/fresh_burn_rate_{windows[0]}s:"
+        f"value={fast_burn:g}"
+    ]
+    if len(windows) > 1:
+        rules.append(
+            f"threshold@name=fresh_burn_slow:field={prefix}/fresh_burn_rate_{windows[-1]}s:"
+            f"value={slow_burn:g}"
+        )
+    return ",".join(rules)
+
+
 __all__ = [
     "DEFAULT_FAST_BURN",
     "DEFAULT_SLOW_BURN",
     "DEFAULT_WINDOWS",
+    "FreshnessBurnTracker",
     "SLOBurnTracker",
+    "fresh_alert_spec",
     "serve_alert_spec",
 ]

@@ -74,6 +74,7 @@ def default_replica_argv(
     host: str = "127.0.0.1",
     buckets=(1, 8, 32),
     slo_ms: float = 1000.0,
+    fresh_max_age_s: float = 0.0,
 ) -> list:
     """argv for one `moco_tpu.serve.replica_main` child."""
     argv = [
@@ -85,6 +86,8 @@ def default_replica_argv(
         "--buckets", ",".join(str(b) for b in buckets),
         "--slo-ms", str(slo_ms),
     ]
+    if fresh_max_age_s:
+        argv += ["--fresh-max-age-s", str(float(fresh_max_age_s))]
     if workdir:
         argv += ["--workdir", os.path.join(workdir, f"replica{index}")]
     return argv
@@ -135,15 +138,24 @@ class ReplicaSupervisor:
         restart_backoff_s: float = 0.5,
         restart_backoff_cap_s: float = 10.0,
         auto_restart: bool = True,
+        fresh_max_age_s: float = 0.0,
     ):
         if num_replicas < 1:
             raise ValueError("a fleet needs at least one replica")
+        # the SWAPPABLE checkpoint dir: the promotion pipeline calls
+        # `set_ckpt_dir(candidate)` and then restarts replicas one at a
+        # time — each respawn reads the CURRENT value, which is how a
+        # staged rollout (and its rollback) changes the served encoder
+        # without changing the replica's URL
+        self._ckpt_dir = str(ckpt_dir) if ckpt_dir is not None else None
+        self._custom_argv = argv_for is not None
         if argv_for is None:
             if ckpt_dir is None:
                 raise ValueError("need ckpt_dir or argv_for")
             argv_for = lambda index, port: default_replica_argv(
-                ckpt_dir, workdir, index, port,
+                self._ckpt_dir, workdir, index, port,
                 host=host, buckets=buckets, slo_ms=slo_ms,
+                fresh_max_age_s=fresh_max_age_s,
             )
         self._argv_for = argv_for
         self.host = host
@@ -166,6 +178,37 @@ class ReplicaSupervisor:
         self._monitor: Optional[threading.Thread] = None
 
     # -- topology ---------------------------------------------------------
+
+    def ckpt_dir(self) -> Optional[str]:
+        """The checkpoint dir the NEXT (re)spawn serves from."""
+        with self._lock:
+            return self._ckpt_dir
+
+    def set_ckpt_dir(self, path: str) -> None:
+        """Point future (re)spawns at a different checkpoint dir — the
+        promotion swap. Running replicas are untouched; the staged
+        rollout restarts them one at a time through the router's drain
+        path. Raises with a custom `argv_for` (the supervisor can't know
+        how to thread the dir into a caller-built argv)."""
+        if self._custom_argv:
+            raise RuntimeError(
+                "set_ckpt_dir needs the default replica argv (a custom "
+                "argv_for owns its own checkpoint wiring)"
+            )
+        with self._lock:
+            self._ckpt_dir = str(path)
+        self._record("ckpt_swap", -1, ckpt_dir=str(path))
+
+    def clear_extra_env(self, index: int) -> None:
+        """Drop the per-replica env overrides for slot `index` so its
+        NEXT respawn comes up clean — the chaos harness healing a
+        replica. Persistent fault rules (e.g. a slow@ stage injected via
+        MOCO_FAULTS) otherwise re-install on every respawn, and a
+        staged rollout soaking on fleet burn gauges would (correctly)
+        refuse to promote into a permanently-burning fleet."""
+        with self._lock:
+            self._extra_env.pop(int(index), None)
+        self._record("heal", int(index))
 
     def url(self, index: int) -> str:
         return f"http://{self.host}:{self._children[index].port}"
@@ -201,7 +244,9 @@ class ReplicaSupervisor:
 
     def _child_env(self, index: int, scrub_kills: bool) -> dict:
         env = dict(self._env)
-        env.update(self._extra_env.get(index, {}))
+        with self._lock:
+            overrides = dict(self._extra_env.get(index, {}))
+        env.update(overrides)
         if scrub_kills and env.get("MOCO_FAULTS"):
             # a kill@replica rule already fired for this slot: the
             # reborn process must not inherit its own death warrant

@@ -60,6 +60,7 @@ from __future__ import annotations
 
 import functools
 import os
+import time
 from typing import Optional, Sequence
 
 import jax
@@ -408,6 +409,12 @@ class EmbeddingIndex:
         self.requested_capacity = int(capacity)
         self.count = 0  # valid rows (host-side; queries read a device copy)
         self._ptr = 0  # FIFO write head (host-side mirror)
+        # wall-clock ingest stamps (freshness SLO): one host-side float
+        # per row slot, NaN = never written. The training queue_age
+        # gauge is STEP-denominated; serving staleness must be wall
+        # seconds — `row_age_stats()` reads these, the serve flusher
+        # feeds them to the FreshnessBurnTracker.
+        self._row_time = np.full(self.capacity, np.nan, np.float64)
         self._row_sharding = None
         self._rep_sharding = None
         self._scale_sharding = None
@@ -437,13 +444,17 @@ class EmbeddingIndex:
 
     # -- ingest ----------------------------------------------------------
 
-    def snapshot(self, embeddings: np.ndarray, normalized: bool = True) -> None:
+    def snapshot(
+        self, embeddings: np.ndarray, normalized: bool = True,
+        now: Optional[float] = None,
+    ) -> None:
         """Bulk (re)load: replace the store's contents with `embeddings`
         (n <= capacity rows) — the "load the trained dictionary" path
         (e.g. a checkpoint's queue). Resets the FIFO head. Invalidates a
         trained IVF structure (cell membership is content-derived —
         retrain with `train_ivf` after a bulk reload); the int8 mirror
-        is requantized in place."""
+        is requantized in place. Every loaded row is ingest-stamped at
+        `now` (wall clock by default; injectable for tests)."""
         embs = np.asarray(embeddings)
         n = embs.shape[0]
         if n > self.capacity or embs.shape[1] != self.dim:
@@ -460,6 +471,8 @@ class EmbeddingIndex:
         self.rows = rows
         self.count = n
         self._ptr = n % self.capacity
+        self._row_time[:] = np.nan
+        self._row_time[:n] = time.time() if now is None else now
         self._ivf = None  # content replaced wholesale: cells are stale
         if self._rows_i8 is not None:
             self._requantize_all()
@@ -521,14 +534,16 @@ class EmbeddingIndex:
                 self._rows_i8, self._row_scale, values.astype(jnp.float32), p
             )
 
-    def add(self, embeddings: np.ndarray) -> None:
+    def add(self, embeddings: np.ndarray, now: Optional[float] = None) -> None:
         """FIFO ingest of an (N, dim) block at the write head — the
         serving-side mirror of the training enqueue. A block crossing
         the capacity boundary splits into two no-wrap writes (training
         keeps its K % N == 0 invariant and never takes the split). The
         write is a donated jitted device update that keeps the P(data)
         sharding in place; the int8 mirror and IVF cell membership (when
-        enabled/trained) follow incrementally."""
+        enabled/trained) follow incrementally. Overwritten slots get a
+        fresh ingest stamp at `now` — FIFO eviction is what keeps the
+        freshness SLO honest (the oldest stamp leaves with its row)."""
         embs = jnp.asarray(embeddings, self.rows.dtype)
         n = embs.shape[0]
         if n == 0:
@@ -550,6 +565,7 @@ class EmbeddingIndex:
             self._write_block(block, p)
         if self._ivf is not None:
             self._ivf_reassign(overwritten, np.asarray(embs, np.float32))
+        self._row_time[overwritten] = time.time() if now is None else now
         self._ptr = (self._ptr + n) % self.capacity
         self.count = min(self.count + n, self.capacity)
 
@@ -567,6 +583,24 @@ class EmbeddingIndex:
         idx.count = rows.shape[0] if count is None else int(count)
         idx._ptr = int(queue_ptr)
         return idx
+
+    def row_age_stats(self, now: Optional[float] = None) -> dict:
+        """Wall-clock staleness of the valid rows: max/mean seconds
+        since each row's ingest stamp. `{"row_age_max_s": None, ...}`
+        while no stamped rows exist (empty index). The serve flusher
+        exports these as `serve/row_age_max_s`/`serve/row_age_mean_s`
+        and feeds the max to the freshness burn tracker; `now` is
+        injectable so the burn math is unit-testable."""
+        now = time.time() if now is None else now
+        stamps = self._row_time[: self.count]
+        valid = stamps[np.isfinite(stamps)]
+        if valid.size == 0:
+            return {"row_age_max_s": None, "row_age_mean_s": None}
+        ages = np.maximum(now - valid, 0.0)
+        return {
+            "row_age_max_s": float(ages.max()),
+            "row_age_mean_s": float(ages.mean()),
+        }
 
     # -- int8 scoring path ----------------------------------------------
 

@@ -1,0 +1,342 @@
+"""Checkpoint promotion for the serving fleet: gates, audit ledger,
+staged rollout.
+
+Training keeps writing checkpoints; the fleet keeps serving an encoder
+whose index rows were embedded by SOME checkpoint. MoCo's dictionary
+consistency (He et al., arXiv:1911.05722) makes the handoff dangerous:
+a candidate encoder can be healthy in isolation yet incompatible with
+the live embedding space, and recall degrades with no error and no
+5xx. This module closes the train→serve loop as an *auditable
+pipeline* instead of a copy-the-checkpoint convention:
+
+- **Gate battery** (`run_gate_battery`) — the candidate must clear
+  declared floors before it touches traffic: `compat_cosine` and
+  `recall_overlap` from `obs/quality.py` (embedding-space compatibility
+  against the LIVE encoder and index), `feature_std` (the PR 3
+  dimensional-collapse gauge on the candidate's probe embeddings,
+  normalized so 1.0 ≈ uniform-sphere spread), and — when the
+  candidate's query/key param trees are supplied — an `ema_drift`
+  ceiling (a key encoder that tore away from its query twin does not
+  provide consistent dictionary keys). An optional `live_recall` floor
+  thresholds the fleet's current `serve/recall_estimate` so a
+  promotion never launches from an already-degraded baseline.
+- **Audit ledger** (`PromotionLedger`) — every verdict is an
+  append-only `promotions.jsonl` line, schema-validated BEFORE it is
+  written (`event: "promotion"`, obs/schema.py): the verdict, the
+  stage, the candidate digest, and per-gate evidence
+  (`promotion/gate/<name>` value vs `promotion/floor/<name>`, with
+  `promotion/gate_ok/<name>` as 0/1). A rejected checkpoint names the
+  gate that killed it; an accepted one carries the numbers that let it
+  through.
+- **Staged rollout** (`StagedRollout`) — one replica at a time through
+  the PR 16 router: swap (drain → restart onto the candidate → wait
+  re-admitted with the candidate's digest), then SOAK watching the
+  fleet burn gauges; a breach auto-rolls every swapped replica back to
+  the previous checkpoint. The machine takes injectable `swap` /
+  `status` / `burn` callables plus a deterministic clock, so the state
+  transitions (including the rollback path) are unit-testable without
+  a fleet.
+
+`scripts/serve_promote.py` is the CLI that wires real engines, the
+router's `/admin/promote` endpoint, and a watch loop around these
+pieces; `scripts/fleet_serve_smoke.py` proves the full loop end to end.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from moco_tpu.analysis import tsan
+from moco_tpu.obs import quality, schema
+from moco_tpu.obs.slo import DEFAULT_FAST_BURN
+
+# Promotion verdicts (obs/schema.py validates the ledger against this
+# set): gates either "accepted"/"rejected" a candidate; a rollout ends
+# "promoted" or "rolled_back".
+VERDICTS = ("accepted", "rejected", "promoted", "rolled_back")
+
+# Default gate floors. `feature_std` is normalized by sqrt(dim) so 1.0
+# is the uniform-sphere value (obs/health.py); `ema_drift_max` is a
+# CEILING (the gate fails above it); `live_recall` is opt-in (None =
+# not gated) because a fleet without online-recall sampling has no
+# baseline to threshold.
+DEFAULT_FLOORS = {
+    "compat_cosine": 0.90,
+    "recall_overlap": 0.60,
+    "feature_std": 0.25,
+    "ema_drift_max": 0.50,
+    "live_recall": None,
+}
+
+
+def _gate_floor(value, floor) -> dict:
+    v = None if value is None else float(value)
+    return {"value": v, "floor": float(floor), "ok": v is not None and v >= float(floor)}
+
+
+def _gate_ceiling(value, ceiling) -> dict:
+    # ledger-side the threshold still lands in `promotion/floor/<name>`
+    # (one evidence shape for every gate); the `_max` suffix in the
+    # gate's name is what says "fail above, not below"
+    v = None if value is None else float(value)
+    return {
+        "value": v,
+        "floor": float(ceiling),
+        "ok": v is not None and v <= float(ceiling),
+    }
+
+
+def run_gate_battery(
+    live_engine,
+    cand_engine,
+    probes,
+    index=None,
+    k: int = 5,
+    mode: str = "exact",
+    floors: Optional[dict] = None,
+    cand_params_q=None,
+    cand_params_k=None,
+    live_recall: Optional[float] = None,
+) -> dict:
+    """Evaluate every promotion gate for one candidate encoder.
+
+    Returns `{"ok", "failed_gate", "gates", "compat"}`: `gates` maps
+    gate name → `{"value", "floor", "ok"}` (insertion order is the
+    evaluation order; `failed_gate` is the FIRST failure, the one the
+    ledger names), `compat` is the schema'd
+    `serve/compat_cosine`/`serve/recall_overlap` gauge pair. Engines
+    are duck-typed (`embed(images) -> (emb, executed)`) so tests drive
+    the battery with fakes."""
+    f = dict(DEFAULT_FLOORS)
+    f.update(floors or {})
+    probes = np.asarray(probes)
+    live_emb, _ = live_engine.embed(probes)
+    cand_emb, _ = cand_engine.embed(probes)
+    cosine = quality.compat_cosine(live_emb, cand_emb)
+    overlap = None
+    gates = {"compat_cosine": _gate_floor(cosine, f["compat_cosine"])}
+    if index is not None and getattr(index, "count", 0) > 0:
+        overlap = quality.recall_overlap(live_emb, cand_emb, index, k=k, mode=mode)
+        gates["recall_overlap"] = _gate_floor(overlap, f["recall_overlap"])
+    # dimensional-collapse check on the CANDIDATE's embeddings — the
+    # PR 3 health gauge, rescaled so 1.0 ≈ uniform on the sphere
+    from moco_tpu.obs import health
+
+    cand_np = np.asarray(cand_emb, np.float32)
+    fstd = float(np.asarray(health.feature_stats(cand_np)["feature_std"]))
+    gates["feature_std"] = _gate_floor(
+        fstd * float(np.sqrt(cand_np.shape[-1])), f["feature_std"]
+    )
+    if cand_params_q is not None and cand_params_k is not None:
+        drift = float(
+            np.asarray(health.ema_drift(cand_params_q, cand_params_k)["ema_drift"])
+        )
+        gates["ema_drift_max"] = _gate_ceiling(drift, f["ema_drift_max"])
+    if f.get("live_recall") is not None and live_recall is not None:
+        gates["live_recall"] = _gate_floor(live_recall, f["live_recall"])
+    failed = next((name for name, g in gates.items() if not g["ok"]), None)
+    return {
+        "ok": failed is None,
+        "failed_gate": failed,
+        "gates": gates,
+        "compat": quality.compat_payload(cosine, overlap),
+    }
+
+
+def ledger_record(
+    step: int,
+    verdict: str,
+    stage: str,
+    digest: Optional[str] = None,
+    failed_gate: Optional[str] = None,
+    replica: Optional[int] = None,
+    gates: Optional[dict] = None,
+    compat: Optional[dict] = None,
+    now: Optional[float] = None,
+) -> dict:
+    """One schema'd promotion event line: verdict + stage + candidate
+    identity, per-gate evidence flattened to
+    `promotion/gate/<name>` / `promotion/floor/<name>` /
+    `promotion/gate_ok/<name>`, and the compat gauge pair."""
+    if verdict not in VERDICTS:
+        raise ValueError(f"verdict must be one of {VERDICTS}, got {verdict!r}")
+    rec = {
+        "step": int(step),
+        "time": time.time() if now is None else float(now),
+        "event": "promotion",
+        "promotion/step": int(step),
+        "promotion/verdict": str(verdict),
+        "promotion/stage": str(stage),
+        "promotion/digest": digest,
+        "promotion/failed_gate": failed_gate,
+        "promotion/replica": int(replica) if replica is not None else None,
+    }
+    for name, g in (gates or {}).items():
+        rec[f"promotion/gate/{name}"] = g["value"]
+        rec[f"promotion/floor/{name}"] = g["floor"]
+        rec[f"promotion/gate_ok/{name}"] = int(bool(g["ok"]))
+    rec.update(compat or {})
+    return rec
+
+
+class PromotionLedger:
+    """Append-only `promotions.jsonl`: the promotion pipeline's audit
+    trail. Every record is validated against the obs schema BEFORE the
+    write (an unschema'd verdict never lands on disk) and serialized
+    with `allow_nan=False` (the writer-side twin of `loads_strict`).
+    Append-only by construction: open(..., "a") under a lock, one line
+    per event, never rewritten."""
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        self._lock = tsan.make_lock("promote.ledger")
+
+    def append(self, rec: dict) -> dict:
+        errors = schema.validate_line(rec)
+        if errors:
+            raise ValueError(f"promotion ledger record fails schema: {errors}")
+        line = json.dumps(rec, allow_nan=False)
+        with self._lock:
+            with open(self.path, "a") as fh:
+                fh.write(line + "\n")
+        return rec
+
+    def read(self) -> list:
+        if not os.path.exists(self.path):
+            return []
+        with open(self.path) as fh:
+            return [schema.loads_strict(ln) for ln in fh if ln.strip()]
+
+
+class StagedRollout:
+    """One-replica-at-a-time rollout with burn-gauge soak and
+    auto-rollback — the state machine behind `serve_promote`'s rollout
+    stage, decoupled from HTTP so the transitions are unit-testable.
+
+    Callables (all injectable):
+
+    - `swap(i)` — start moving replica `i` onto the CANDIDATE
+      checkpoint (the CLI posts `/admin/promote?replica=i&ckpt_dir=…`).
+    - `swap_back(i)` — same, onto the PREVIOUS checkpoint (rollback
+      path; defaults to `swap`, which only makes sense in tests).
+    - `status(i)` — that replica's `/admin/replicas` snapshot: the
+      machine waits for `healthy and not draining` and, when
+      `target_digest` is given, for `model_digest` to match it (the
+      swap has LANDED, not merely restarted).
+    - `burn()` — the fleet gauge to soak on (the CLI reads the max of
+      the router's fast-window latency/freshness burn aggregates);
+      any reading above `burn_ceiling` during the soak triggers
+      rollback. None readings (no traffic yet) are not breaches.
+
+    `run()` returns `{"verdict": "promoted"|"rolled_back", "swapped",
+    "replica", "reason", "burn"}` — `replica`/`reason` name the step
+    that failed (`swap_timeout` or `burn_breach`)."""
+
+    def __init__(
+        self,
+        num_replicas: int,
+        swap: Callable[[int], object],
+        status: Callable[[int], dict],
+        burn: Optional[Callable[[], Optional[float]]] = None,
+        swap_back: Optional[Callable[[int], object]] = None,
+        target_digest: Optional[str] = None,
+        soak_s: float = 1.0,
+        swap_timeout_s: float = 60.0,
+        burn_ceiling: float = DEFAULT_FAST_BURN,
+        poll_s: float = 0.2,
+        sleep: Callable[[float], None] = time.sleep,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if num_replicas < 1:
+            raise ValueError(f"num_replicas must be >= 1, got {num_replicas}")
+        self.num_replicas = int(num_replicas)
+        self.swap = swap
+        self.swap_back = swap_back if swap_back is not None else swap
+        self.status = status
+        self.burn = burn
+        self.target_digest = target_digest
+        self.soak_s = float(soak_s)
+        self.swap_timeout_s = float(swap_timeout_s)
+        self.burn_ceiling = float(burn_ceiling)
+        self.poll_s = float(poll_s)
+        self._sleep = sleep
+        self._clock = clock
+
+    def _landed(self, snap: dict, digest: Optional[str]) -> bool:
+        if not snap.get("healthy") or snap.get("draining"):
+            return False
+        if snap.get("drain_phase") is not None:
+            return False
+        return digest is None or snap.get("model_digest") == digest
+
+    def _swap_and_wait(self, index: int, swap_fn, digest: Optional[str]) -> bool:
+        swap_fn(index)
+        deadline = self._clock() + self.swap_timeout_s
+        while self._clock() < deadline:
+            if self._landed(self.status(index), digest):
+                return True
+            self._sleep(self.poll_s)
+        return self._landed(self.status(index), digest)
+
+    def _soak(self) -> Optional[float]:
+        """None = clean soak; a float = the breaching burn reading."""
+        if self.burn is None or self.soak_s <= 0:
+            return None
+        deadline = self._clock() + self.soak_s
+        while True:
+            b = self.burn()
+            if b is not None and float(b) > self.burn_ceiling:
+                return float(b)
+            if self._clock() >= deadline:
+                return None
+            self._sleep(self.poll_s)
+
+    def run(self) -> dict:
+        swapped: list = []
+        for i in range(self.num_replicas):
+            if not self._swap_and_wait(i, self.swap, self.target_digest):
+                return self._rollback(swapped, i, "swap_timeout", None)
+            swapped.append(i)
+            breach = self._soak()
+            if breach is not None:
+                return self._rollback(swapped, i, "burn_breach", breach)
+        return {
+            "verdict": "promoted",
+            "swapped": swapped,
+            "replica": None,
+            "reason": None,
+            "burn": None,
+        }
+
+    def _rollback(
+        self, swapped: Sequence[int], failed: int, reason: str, burn: Optional[float]
+    ) -> dict:
+        # every replica that touched the candidate goes back — including
+        # the one whose swap timed out (it may have half-landed); no
+        # digest wait on the way back (the previous encoder's digest is
+        # unknown here), just healthy re-admission
+        for j in dict.fromkeys(list(swapped) + [failed]):
+            self._swap_and_wait(j, self.swap_back, None)
+        return {
+            "verdict": "rolled_back",
+            "swapped": list(swapped),
+            "replica": int(failed),
+            "reason": reason,
+            "burn": burn,
+        }
+
+
+__all__ = [
+    "DEFAULT_FLOORS",
+    "PromotionLedger",
+    "StagedRollout",
+    "VERDICTS",
+    "ledger_record",
+    "run_gate_battery",
+]

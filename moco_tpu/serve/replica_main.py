@@ -41,6 +41,11 @@ def build_argparser() -> argparse.ArgumentParser:
     ap.add_argument("--neighbors-k", type=int, default=5)
     ap.add_argument("--metrics-flush-s", type=float, default=1.0)
     ap.add_argument("--drain-timeout-s", type=float, default=30.0)
+    ap.add_argument(
+        "--fresh-max-age-s", type=float, default=0.0,
+        help="freshness SLO: max index-row age in wall seconds "
+        "(0 = no freshness objective declared)",
+    )
     return ap
 
 
@@ -53,11 +58,13 @@ def main(argv=None) -> int:
     import os
 
     from moco_tpu.analysis import contracts as contract_cov
+    from moco_tpu.obs import quality
     from moco_tpu.obs.sinks import JsonlSink
     from moco_tpu.serve.engine import InferenceEngine, load_serving_encoder
     from moco_tpu.serve.index import EmbeddingIndex
     from moco_tpu.serve.server import ServeServer
     from moco_tpu.utils import faults
+    from moco_tpu.utils.checkpoint import CheckpointManager
 
     faults.install_from_env()
     # contract-coverage arm: MOCO_CONTRACT_COVERAGE=1 (planted by a
@@ -74,6 +81,13 @@ def main(argv=None) -> int:
         image_size=config.data.image_size, buckets=buckets,
     )
     index = EmbeddingIndex.from_train_queue(queue, queue_ptr)
+    # served-model identity: which checkpoint step this encoder came
+    # from + a content digest of its params — /stats and /admin/model
+    # expose both, so the router's version-skew gauge has real data
+    mgr = CheckpointManager(args.ckpt_dir)
+    model_step = mgr.latest_step()
+    mgr.close()
+    model_digest = quality.params_digest(params)
     sink = None
     if args.workdir:
         os.makedirs(args.workdir, exist_ok=True)
@@ -90,6 +104,9 @@ def main(argv=None) -> int:
         metrics_flush_s=args.metrics_flush_s,
         workdir=args.workdir,
         replica_index=args.replica_index,
+        model_step=model_step,
+        model_digest=model_digest,
+        fresh_max_age_s=args.fresh_max_age_s or None,
     )
     print(
         f"replica {args.replica_index} serving on "

@@ -37,7 +37,13 @@ so a flight-recorder dump blames the right process), `GET /healthz`,
 (fleet topology — `scripts/serve_ingest.py --fanout` discovers the
 replica URLs here), `GET /debug/flight` (the fleet flight ring),
 `POST /admin/drain?replica=i[&restart=0]`,
-`POST /admin/undrain?replica=i`.
+`POST /admin/undrain?replica=i`, and
+`POST /admin/promote?replica=i&ckpt_dir=<path>` (one staged-rollout
+step: retarget the supervisor's checkpoint dir, then drain/restart that
+replica into the candidate encoder — `serve/promote.py` drives it
+replica-by-replica, watching burn gauges between steps, and
+`fleet_serve/model_skew` counts the distinct served versions so a
+half-finished rollout is a visible gauge, not a silent mix).
 
 Observability rides the PR 10 rails: the router's own client-observed
 `SLOBurnTracker` exports `fleet_serve/burn_rate_<w>s` (the chaos leg's
@@ -85,6 +91,7 @@ import socket
 import threading
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 from collections import Counter, deque
 from typing import Optional
@@ -234,6 +241,11 @@ class ReplicaHandle:
             "breaker_trips": self.breaker.trips,
             "inflight": self.inflight,
             "dispatched": self.dispatched,
+            # served-model identity from the last /stats poll: the
+            # version-skew gauge and the promotion rollout both watch
+            # these (None until the poller has seen the replica)
+            "model_step": self.stats.get("serve/model_step"),
+            "model_digest": self.stats.get("serve/model_digest"),
         }
 
 
@@ -522,7 +534,16 @@ class RouterMetrics:
                 "fleet_serve/p99_ms": pct(0.99),
                 "fleet_serve/slo_ms": self.slo_ms,
             }
-        for name in ("hedges", "hedge_wins", "shed", "failed", "drains"):
+        for name in (
+            "hedges",
+            "hedge_wins",
+            "shed",
+            "failed",
+            "drains",
+            # staged-rollout steps accepted (promote_replica): the
+            # promotion audit trail's fleet-side counter
+            "promotions",
+        ):
             out[f"fleet_serve/{name}"] = counters.get(name, 0)
         # hedge-loser accounting: the cumulative cost of every cancelled
         # lane (the latency that used to vanish with the discarded
@@ -724,6 +745,9 @@ class FleetRouter:
                 if path == "/admin/undrain":
                     self._handle_admin_undrain(query)
                     return
+                if path == "/admin/promote":
+                    self._handle_admin_promote(query)
+                    return
                 if path not in ("/embed", "/neighbors"):
                     self.send_error(404)
                     return
@@ -832,6 +856,30 @@ class FleetRouter:
                     return
                 restart = _query_flag(query, "restart", default=None)
                 started = server.drain_replica(idx, restart=restart)
+                with server._fleet_lock:
+                    snap = server._replicas[idx].snapshot()
+                self._json(202, {"accepted": started, "replica": snap})
+
+            def _handle_admin_promote(self, query):
+                # one staged-rollout step: point the supervisor at the
+                # candidate checkpoint dir and drain/restart ONE replica
+                # into it (the promotion controller drives this per
+                # replica, watching burn gauges between steps)
+                idx = _parse_replica(query, len(server._replicas))
+                if idx is None:
+                    self._json(400, {"error": "need replica=<index>"})
+                    return
+                ckpt_dir = _query_param(query, "ckpt_dir")
+                if ckpt_dir is None:
+                    self._json(400, {"error": "need ckpt_dir=<path>"})
+                    return
+                try:
+                    started = server.promote_replica(
+                        idx, urllib.parse.unquote(ckpt_dir)
+                    )
+                except RuntimeError as e:
+                    self._json(409, {"error": str(e)})
+                    return
                 with server._fleet_lock:
                     snap = server._replicas[idx].snapshot()
                 self._json(202, {"accepted": started, "replica": snap})
@@ -1155,6 +1203,22 @@ class FleetRouter:
         self._drain_q.put((index, bool(restart)))
         return True
 
+    def promote_replica(self, index: int, ckpt_dir: str) -> bool:
+        """One promotion step: retarget the supervisor's checkpoint dir
+        at `ckpt_dir`, then drain/restart replica `index` so it comes
+        back serving the candidate encoder. Asynchronous like
+        `drain_replica` (False = that replica is already draining);
+        the caller polls `/admin/replicas` for the swap landing (the
+        replica's `model_digest` changes when it re-admits)."""
+        if self._supervisor is None:
+            raise RuntimeError(
+                "promotion needs a supervisor-backed fleet "
+                "(no supervisor attached to this router)"
+            )
+        self._supervisor.set_ckpt_dir(ckpt_dir)
+        self.metrics.count("promotions")
+        return self.drain_replica(index, restart=True)
+
     def undrain_replica(self, index: int) -> None:
         with self._fleet_lock:
             rep = self._replicas[index]
@@ -1248,7 +1312,15 @@ class FleetRouter:
             out[f"fleet_serve/dispatch_{s['index']}"] = s["dispatched"]
         burn_keys = set()
         for st in replica_stats:
-            burn_keys |= {k for k in st if k.startswith("serve/burn_rate_")}
+            burn_keys |= {
+                k
+                for k in st
+                if k.startswith("serve/burn_rate_")
+                or k.startswith("serve/fresh_burn_rate_")
+                # the fleet's live online-recall baseline: the promotion
+                # pipeline's live_recall gate reads the _max aggregate
+                or k == "serve/recall_estimate"
+            }
         for k in sorted(burn_keys):
             vals = [
                 st[k] for st in replica_stats if st.get(k) is not None
@@ -1257,6 +1329,15 @@ class FleetRouter:
             out[base + "_min"] = min(vals) if vals else None
             out[base + "_mean"] = sum(vals) / len(vals) if vals else None
             out[base + "_max"] = max(vals) if vals else None
+        # version-skew gauge: how many DISTINCT encoder versions the
+        # fleet is serving, minus one (0 = homogeneous; >0 mid-rollout
+        # or a stuck replica). None until any replica reports a digest.
+        digests = {
+            st.get("serve/model_digest")
+            for st in replica_stats
+            if st.get("serve/model_digest") is not None
+        }
+        out["fleet_serve/model_skew"] = len(digests) - 1 if digests else None
         router_retries = {
             k: v
             for k, v in retry_mod.snapshot().items()

@@ -13,10 +13,18 @@ Endpoints:
   cosine rows of the sharded EmbeddingIndex, i.e. the MoCo dictionary
   look-up as a product; `ivf` scans only the `nprobe` nearest cells
   (sub-linear — serve/index.py), the int8 modes score quantized.
-- `POST /ingest` — body: raw float32 rows, `X-Rows-Shape: n,d` header.
+- `POST /ingest` — body: raw float32 rows, `X-Rows-Shape: n,d` header
+  (plus the propagated `X-Ckpt-Step` header naming the source training
+  checkpoint step, so encoder/index provenance is visible).
   FIFO-ingests a block into the live index (the streaming-updates path
   `scripts/serve_ingest.py` drives from a training checkpoint dir);
-  IVF cell membership and the int8 mirror follow incrementally.
+  IVF cell membership and the int8 mirror follow incrementally, and
+  every written row gets a wall-clock ingest stamp (the freshness SLO's
+  raw signal). `delay@site=ingest` is the chaos hook that stalls this
+  path.
+- `GET /admin/model` — the served-model identity: checkpoint step +
+  params digest of the encoder answering on this replica, and the last
+  ingest's source checkpoint step (encoder/index skew at a glance).
 - `GET /stats` — the live `serve/*` gauge snapshot as JSON.
 - `GET /healthz` — `{"ok": true, "warm": ..., "draining": false}` once
   the AOT warmup ran; `ok` flips false while draining so a fleet router
@@ -84,7 +92,13 @@ from moco_tpu.obs.alerts import AlertEngine, parse_rules
 from moco_tpu.obs.flight import FlightRecorder
 from moco_tpu.obs.reqtrace import RequestIdAllocator, emit_request_spans
 from moco_tpu.obs.sinks import resolve_serve_port  # noqa: F401  (public API)
-from moco_tpu.obs.slo import DEFAULT_WINDOWS, SLOBurnTracker, serve_alert_spec
+from moco_tpu.obs.slo import (
+    DEFAULT_WINDOWS,
+    FreshnessBurnTracker,
+    SLOBurnTracker,
+    fresh_alert_spec,
+    serve_alert_spec,
+)
 from moco_tpu.obs.trace import Tracer, get_tracer
 from moco_tpu.analysis import tsan
 from moco_tpu.analysis.contracts import record_route
@@ -139,6 +153,10 @@ class ServeServer:
         burn_windows=DEFAULT_WINDOWS,
         alert_spec: str = "serve_default",
         flight_requests: int = 512,
+        model_step: int = None,
+        model_digest: str = None,
+        fresh_max_age_s: float = None,
+        fresh_objective: float = 0.99,
     ):
         if neighbors_mode not in QUERY_MODES:
             raise ValueError(
@@ -152,6 +170,14 @@ class ServeServer:
         self.recall_sample_every = int(recall_sample_every)
         self.workdir = workdir
         self.replica_index = int(replica_index)
+        # served-model identity (obs/quality.py mints the digest): which
+        # encoder answers on this replica — /stats and /admin/model
+        # expose it so fleet version skew is a gauge, not an incident
+        self.model_step = int(model_step) if model_step is not None else None
+        self.model_digest = model_digest
+        # source checkpoint step of the last /ingest block (X-Ckpt-Step
+        # header) — encoder/index provenance skew, replica-side
+        self.ingest_ckpt_step = None
         # request-scoped observability: replica-tagged ids + waterfalls,
         # burn-rate accounting over the declared SLO, flight recorder,
         # and the alert engine that trips the flight dump (module
@@ -162,11 +188,24 @@ class ServeServer:
         self.flight = FlightRecorder(
             max_requests=flight_requests, replica=self.replica_index
         )
+        # freshness SLO (obs/slo.py): declared max index-row age in wall
+        # seconds; each metrics flush records one observation off the
+        # index's ingest stamps, so a stalled ingest burns budget
+        self.fresh = (
+            FreshnessBurnTracker(
+                fresh_max_age_s, objective=fresh_objective, windows=burn_windows
+            )
+            if fresh_max_age_s
+            else None
+        )
         spec = (
             serve_alert_spec(slo_ms, windows=burn.windows)
             if alert_spec == "serve_default"
             else alert_spec
         )
+        if self.fresh is not None and alert_spec == "serve_default":
+            # a declared freshness objective arms its burn alerts too
+            spec = ",".join(s for s in (spec, fresh_alert_spec(windows=burn.windows)) if s)
         self._alerts = (
             AlertEngine(
                 parse_rules(spec),
@@ -247,6 +286,17 @@ class ServeServer:
                     })
                 elif path == "/stats":
                     self._json(200, server.stats())
+                elif path == "/admin/model":
+                    # served-model identity: the promotion pipeline and
+                    # the router's skew gauge read this (and /stats)
+                    with server._index_lock:
+                        ingest_step = server.ingest_ckpt_step
+                    self._json(200, {
+                        "model_step": server.model_step,
+                        "model_digest": server.model_digest,
+                        "ingest_ckpt_step": ingest_step,
+                        "replica": server.replica_index,
+                    })
                 elif path == "/debug/flight":
                     # on-demand flight dump: write the ring to disk when
                     # a workdir exists, and return the snapshot either
@@ -369,12 +419,28 @@ class ServeServer:
                 if server.index is None:
                     self._json(503, {"error": "no embedding index attached"})
                     return
+                # chaos hook: delay@site=ingest stalls the freshness
+                # pipeline HERE (before the body read, outside the index
+                # lock) — row ages keep growing while the block is stuck,
+                # which is exactly what the fresh-burn alert must catch
+                faults.maybe_delay("ingest")
                 try:
                     shape_hdr = self.headers.get("X-Rows-Shape", "")
                     try:
                         n, d = (int(s) for s in shape_hdr.split(","))
                     except ValueError:
                         raise ValueError(f"bad X-Rows-Shape header {shape_hdr!r}")
+                    # propagated provenance header: which training
+                    # checkpoint step produced these rows
+                    ckpt_hdr = self.headers.get("X-Ckpt-Step")
+                    ckpt_step = None
+                    if ckpt_hdr:
+                        try:
+                            ckpt_step = int(ckpt_hdr)
+                        except ValueError:
+                            raise ValueError(
+                                f"bad X-Ckpt-Step header {ckpt_hdr!r}"
+                            )
                     length = int(self.headers.get("Content-Length", 0))
                     if length != n * d * 4:
                         raise ValueError(
@@ -394,6 +460,8 @@ class ServeServer:
                             )
                         server.index.add(rows)
                         server.ingested_rows += n
+                        if ckpt_step is not None:
+                            server.ingest_ckpt_step = ckpt_step
                         index_rows = server.index.count
                         total_ingested = server.ingested_rows
                 except ValueError as e:
@@ -598,7 +666,18 @@ class ServeServer:
         out["serve/quant_tier"] = {"off": 0, "w8": 1, "w8a8": 2}.get(
             getattr(self.engine, "quant", "off"), 0
         )
+        # served-model identity + ingest provenance (obs/quality.py):
+        # the model plane's version gauges — the router's skew gauge
+        # and the promotion pipeline's evidence both read these
+        out["serve/model_step"] = self.model_step
+        out["serve/model_digest"] = self.model_digest
+        out["serve/ingest_ckpt_step"] = self.ingest_ckpt_step
+        if self.fresh is not None:
+            out.update(self.fresh.payload())
         if self.index is not None:
+            ages = self.index.row_age_stats()
+            out["serve/row_age_max_s"] = ages["row_age_max_s"]
+            out["serve/row_age_mean_s"] = ages["row_age_mean_s"]
             out["serve/index_rows"] = self.index.count
             out["serve/ingested_rows"] = self.ingested_rows
             out["serve/recompiles_after_warmup"] += self.index.recompiles_after_warmup
@@ -625,6 +704,17 @@ class ServeServer:
         out to the sink."""
         self._flush_step += 1  # mocolint: disable=JX012  (same join-serialization as _lane: the alert hook fires ON the flusher thread, and close() joins the flusher before the final flush — one writer at a time by construction)
         try:
+            if self.fresh is not None:
+                # one freshness observation per flush: the index's max
+                # row age vs the declared objective (None = empty index,
+                # not stale). Sampled under the index lock, recorded
+                # outside it (obs.slo after serve.index is NOT a
+                # sanctioned nesting — keep them disjoint).
+                age = None
+                if self.index is not None:
+                    with self._index_lock:
+                        age = self.index.row_age_stats()["row_age_max_s"]
+                self.fresh.record(age)
             payload = self.stats()
             self.flight.record_metrics(self._flush_step, payload)
             if self._alerts is not None:

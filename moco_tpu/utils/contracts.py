@@ -118,11 +118,22 @@ ROUTES = {
             "/ingest",
             ("POST",),
             headers=("X-Rows-Shape",),
+            # the source checkpoint step of the posted rows: the replica
+            # reads it into its serve/ingest_ckpt_step gauge so encoder/
+            # index skew is visible (scripts/serve_ingest.py sends it)
+            opt_headers=("X-Ckpt-Step",),
             idempotent=False,
             server="replica",
         ),
         Route("/admin/drain", ("POST",), idempotent=False, server="both"),
         Route("/admin/undrain", ("POST",), idempotent=False, server="router"),
+        # served-model identity (step + params digest + last ingest step)
+        Route("/admin/model", ("GET",), idempotent=True, server="replica"),
+        # one staged-rollout step: retarget the supervisor's checkpoint
+        # dir and drain/restart one replica onto it. NOT idempotent (a
+        # retry would double-drain a replica mid-swap) — the promotion
+        # controller polls /admin/replicas instead of retrying.
+        Route("/admin/promote", ("POST",), idempotent=False, server="router"),
     )
 }
 
@@ -164,6 +175,7 @@ LOCK_SITES = (
     "obs.prometheus",
     "obs.slo",
     "obs.trace",
+    "promote.ledger",
     "router.fleet",
     "router.metrics",
     "serve.index",
@@ -173,7 +185,10 @@ LOCK_SITES = (
 
 FAULT_SITES = {
     "slow": SERVE_STAGE_SITES,
-    "delay": ("data.read", "input.h2d", "zero.gather"),
+    # "ingest": stalls the replica's /ingest handler before the body
+    # read (serve/server.py) — the freshness-SLO chaos lever: rows age
+    # past the declared max while the tail pipeline is stuck.
+    "delay": ("data.read", "input.h2d", "zero.gather", "ingest"),
     "io": ("data.read",),
     "deadlock": LOCK_SITES,
 }
@@ -199,6 +214,20 @@ SERVE_GATED_VALIDATORS = (
     "serve/slo_objective",
 )
 
+# Model-quality / freshness validators a replica with a declared
+# freshness objective must exercise (served-model identity, row-age
+# gauges, and the freshness burn family's prefix).
+
+QUALITY_GATED_VALIDATORS = (
+    "serve/fresh_burn_rate_",
+    "serve/fresh_max_age_s",
+    "serve/ingest_ckpt_step",
+    "serve/model_digest",
+    "serve/model_step",
+    "serve/row_age_max_s",
+    "serve/row_age_mean_s",
+)
+
 # The distributed-tracing validators the ROUTER's metric stream must
 # exercise in a full fleet smoke (critical-path attribution + the
 # hedge-loser cost counter — both only emitted by serve/router.py).
@@ -206,4 +235,17 @@ SERVE_GATED_VALIDATORS = (
 FLEET_GATED_VALIDATORS = (
     "fleet_serve/critpath_",
     "fleet_serve/hedge_wasted_ms",
+)
+
+# The promotion pipeline's ledger validators the fleet smoke's
+# promotion leg must exercise (serve/promote.py writes them through
+# schema.validate_line, so coverage proves real verdict lines landed).
+
+PROMOTION_GATED_VALIDATORS = (
+    "fleet_serve/model_skew",
+    "promotion/",
+    "promotion/digest",
+    "promotion/failed_gate",
+    "promotion/stage",
+    "promotion/verdict",
 )

@@ -48,16 +48,34 @@ The story, executable:
    link router attempts to replica requests — and the offline
    `stitch_traces()` twin must reproduce stitched records from the
    on-disk artifacts alone;
-8. final gates: `fleet_serve/burn_rate_60s` < 1.0 (the chaos never
-   exhausted the client-observed error budget), the flushed
-   `fleet_serve/*` metrics lines schema-strict (including the
-   `fleet_serve/critpath_<hop>_ms` family), and mocolint clean on the
-   fleet modules (JX011/JX012/JX013 — the threaded router must lint
-   clean, not just run clean).
+8. the promotion leg (ISSUE 19): every replica declares a freshness
+   objective and serves its model identity (step + params digest), so
+   the router's `fleet_serve/model_skew` gauge is live. A SKEWED
+   candidate checkpoint (a re-initialized encoder posing as step 1)
+   must be REJECTED by the gate battery — the append-only
+   `promotions.jsonl` ledger names the failing gate with its measured
+   value vs floor — and a compatible candidate must clear the gates
+   and roll out through `POST /admin/promote` one replica at a time
+   under live traffic with ZERO dropped requests, the skew gauge
+   visibly passing through >= 1 mid-rollout and landing back at 0 with
+   every replica reporting the candidate's step and digest;
+9. the freshness leg: an in-process replica with a 1s freshness
+   objective ingests a block, then a `delay@site=ingest` fault stalls
+   the next block inside the handler while the resident rows age past
+   the objective — `serve/row_age_max_s` breaches,
+   `serve/fresh_burn_rate_5s` climbs over the fast-burn threshold, and
+   the `fresh_burn_fast` alert fires (flight dump attached), all on a
+   schema-strict metrics stream;
+10. final gates: `fleet_serve/burn_rate_60s` < 1.0 (the chaos never
+    exhausted the client-observed error budget), the flushed
+    `fleet_serve/*` metrics lines schema-strict (including the
+    `fleet_serve/critpath_<hop>_ms` family), and mocolint clean on the
+    fleet + promotion modules (JX011/JX012/JX013 — the threaded router
+    must lint clean, not just run clean).
 
 CI runs this in the tier-1 job; the router metrics stream, the merged
-fleet trace, the router flight dump, the summary JSON, and the
-supervisor event log upload as artifacts.
+fleet trace, the router flight dump, the promotion ledger, the summary
+JSON, and the supervisor event log upload as artifacts.
 """
 
 from __future__ import annotations
@@ -97,6 +115,21 @@ SLOW_MS = 2500.0
 KILL_AT = 5  # replica 1 dies handling its 5th data POST — mid-burst
 RESPAWN_DEADLINE_S = 420.0
 DRAIN_DEADLINE_S = 420.0
+# freshness SLO declared fleet-wide: generous vs the smoke's own wall
+# time so the MAIN fleet never burns it — the tight-objective burn
+# story runs in the dedicated freshness leg instead
+FRESH_MAX_AGE_S = 600.0
+# promotion leg: probe batch for the gate battery, plus the collapse
+# floor the UNTRAINED toy encoder actually clears (~0.08 measured —
+# the 0.25 default calibrates to trained encoders; the floor is a
+# deployment knob and the smoke's deployment is a random init)
+PROMOTE_PROBES = 16
+PROMOTE_FEATURE_STD_FLOOR = 0.05
+# freshness leg: a 1s objective, and an ingest stall long enough that
+# the resident rows age past it while the handler is stuck
+STALL_FRESH_MAX_AGE_S = 1.0
+STALL_DELAY_S = 2.5
+STALL_DEADLINE_S = 60.0
 # stitched hop-sum vs client wall: relative eps dominates at the smoke's
 # realistic latencies; the absolute floor covers the fast path
 TRACE_EPS_FRAC = 0.15
@@ -107,6 +140,140 @@ STITCH_DEADLINE_S = 120.0  # hedge losers (the 2.5s lane) must land first
 def _get(url: str, timeout: float = 10.0) -> dict:
     with urllib.request.urlopen(url, timeout=timeout) as r:
         return json.loads(r.read())
+
+
+def _make_compatible_candidate(live_dir: str, out_dir: str, step: int = 1) -> None:
+    """The live encoder nudged by a uniform 1e-3 weight scale, saved as
+    a step-`step` checkpoint: the params digest changes (the rollout's
+    landing signal needs a NEW digest to wait on) but the normalized
+    embeddings barely move — the 'one more epoch' stand-in the gate
+    battery must wave through."""
+    import jax
+
+    from moco_tpu.lincls import restore_pretrain_state
+    from moco_tpu.utils.checkpoint import CheckpointManager
+    from moco_tpu.utils.config import config_to_dict
+
+    state, config = restore_pretrain_state(live_dir)
+    nudge = lambda t: jax.tree_util.tree_map(lambda x: x * (1.0 + 1e-3), t)
+    state = state.replace(
+        params_q=nudge(state.params_q), params_k=nudge(state.params_k)
+    )
+    mgr = CheckpointManager(out_dir)
+    mgr.save(
+        step, state,
+        extra={"epoch": 0, "config": config_to_dict(config), "num_data": 1},
+        force=True,
+    )
+    mgr.close()
+
+
+def _freshness_stall_leg(workdir: str) -> dict:
+    """The freshness-SLO story at smoke scale, on a dedicated in-process
+    replica (its own `workdir/freshness` stream — a tight 1s objective
+    on the MAIN fleet would burn on wall time alone): ingest a block,
+    watch it stay fresh, then stall the next `/ingest` inside the
+    handler with `delay@site=ingest` while the resident rows age out —
+    the fresh-burn gauge must breach and the `fresh_burn_fast` alert
+    must fire."""
+    import numpy as np
+
+    from moco_tpu.obs import schema
+    from moco_tpu.obs.alerts import read_alerts
+    from moco_tpu.obs.sinks import JsonlSink
+    from moco_tpu.obs.slo import DEFAULT_FAST_BURN
+    from moco_tpu.serve.index import EmbeddingIndex
+    from moco_tpu.serve.server import ServeServer
+    from moco_tpu.utils import faults
+
+    class _IngestOnlyEngine:
+        """Engine-shaped stub: this leg exercises the ingest/freshness
+        plane, never the embed path."""
+
+        buckets = (1,)
+        recompiles_after_warmup = 0
+        num_features = 4
+        image_size = 4
+
+        def warmup(self):
+            pass
+
+        def embed(self, images, stages=None):
+            emb = np.zeros((images.shape[0], 4), np.float32)
+            return emb, [(images.shape[0], images.shape[0])]
+
+    wd = os.path.join(workdir, "freshness")
+    os.makedirs(wd, exist_ok=True)
+    sink = JsonlSink(wd)
+    server = ServeServer(
+        _IngestOnlyEngine(),
+        index=EmbeddingIndex(64, 4),
+        port=0,
+        sink=sink,
+        metrics_flush_s=0.1,
+        workdir=wd,
+        fresh_max_age_s=STALL_FRESH_MAX_AGE_S,
+        burn_windows=(5, 60),
+    )
+    stall_base = f"http://127.0.0.1:{server.port}"
+
+    def _ingest(block, step: int) -> None:
+        req = urllib.request.Request(
+            stall_base + "/ingest",
+            data=block.astype(np.float32).tobytes(),
+            headers={
+                "X-Rows-Shape": ",".join(map(str, block.shape)),
+                "X-Ckpt-Step": str(step),
+            },
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            r.read()
+
+    try:
+        rng = np.random.default_rng(7)
+        _ingest(rng.standard_normal((8, 4)), 0)
+        time.sleep(0.4)  # a few fresh observations land
+        st = _get(stall_base + "/stats")
+        assert st["serve/fresh_max_age_s"] == STALL_FRESH_MAX_AGE_S, st
+        assert (st.get("serve/fresh_burn_rate_5s") or 0.0) == 0.0, (
+            f"freshness burned before the stall: {st}"
+        )
+        # the stall: the NEXT block sticks in the handler while the
+        # resident rows age past the declared objective
+        faults.install(f"delay@site=ingest:seconds={STALL_DELAY_S}")
+        try:
+            _ingest(rng.standard_normal((8, 4)), 1)
+        finally:
+            faults.clear()
+        deadline = time.monotonic() + STALL_DEADLINE_S
+        burn, fired = None, []
+        while time.monotonic() < deadline:
+            st = _get(stall_base + "/stats")
+            burn = st.get("serve/fresh_burn_rate_5s")
+            fired = [
+                a for a in read_alerts(os.path.join(wd, "alerts.jsonl"))
+                if a["rule"] == "fresh_burn_fast"
+            ]
+            if burn is not None and burn > DEFAULT_FAST_BURN and fired:
+                break
+            time.sleep(0.1)
+        assert burn is not None and burn > DEFAULT_FAST_BURN, (
+            f"the ingest stall never breached the fresh burn gauge: {burn}"
+        )
+        assert fired, "fresh_burn_fast never fired despite the breach"
+        st = _get(stall_base + "/stats")
+        assert st["serve/row_age_max_s"] > STALL_FRESH_MAX_AGE_S, st
+        assert st["serve/ingest_ckpt_step"] == 1, st
+    finally:
+        server.close()
+        sink.close()
+    problems = schema.validate_file(os.path.join(wd, "metrics.jsonl"))
+    assert not problems, f"freshness leg schema violations: {problems[:5]}"
+    return {
+        "fresh_burn_rate_5s": burn,
+        "fresh_alerts": len(fired),
+        "row_age_max_s": st["serve/row_age_max_s"],
+    }
 
 
 def run_smoke(workdir: str, contract_coverage: bool = False) -> dict:
@@ -151,6 +318,9 @@ def run_smoke(workdir: str, contract_coverage: bool = False) -> dict:
         boot_timeout_s=RESPAWN_DEADLINE_S,
         monitor_interval_s=0.25,
         restart_backoff_s=0.5,
+        # every replica declares the freshness objective: the fresh-burn
+        # gauge family + row-age gauges go live on every /stats
+        fresh_max_age_s=FRESH_MAX_AGE_S,
     )
     print(f"booting {NUM_REPLICAS} replicas (AOT warmup each)...", flush=True)
     t_boot = time.monotonic()
@@ -398,12 +568,188 @@ def run_smoke(workdir: str, contract_coverage: bool = False) -> dict:
         import serve_ingest
 
         fresh = rng.standard_normal((10, 16)).astype(np.float32)
-        results = serve_ingest.fanout_rows(base, fresh)
+        # the rows' source checkpoint step rides the X-Ckpt-Step header:
+        # every replica's serve/ingest_ckpt_step gauge picks it up
+        results = serve_ingest.fanout_rows(base, fresh, ckpt_step=0)
         assert set(results) == set(range(NUM_REPLICAS)) and all(
             v is not None for v in results.values()
         ), f"fanout dropped a replica: {results}"
+        provenance = _get(sup.url(0) + "/admin/model")
+        assert provenance["ingest_ckpt_step"] == 0, (
+            f"X-Ckpt-Step never reached the ingest gauge: {provenance}"
+        )
         print(f"fanout ingest landed on all {NUM_REPLICAS} replicas: {results}",
               flush=True)
+
+        # -- promotion: gate battery + audit ledger + staged rollout -------
+        import serve_promote
+
+        # heal the fleet first: the burst leg's slowed replica carries
+        # its slow@ fault in the spawn env, so every request it serves
+        # blows the replica SLO and pins its latency burn at the cap —
+        # and the rollout soak (correctly) refuses to promote into a
+        # burning fleet. Clear the fault env, cycle the replica clean,
+        # and wait for every fleet burn gauge to settle under the
+        # rollout ceiling before any candidate goes near traffic.
+        sup.clear_extra_env(SLOWED_REPLICA)
+        assert router.drain_replica(SLOWED_REPLICA), (
+            "slowed replica was already draining at heal time"
+        )
+        deadline = time.monotonic() + RESPAWN_DEADLINE_S
+        while time.monotonic() < deadline:
+            snap = next(
+                s for s in _get(base + "/admin/replicas")["replicas"]
+                if s["index"] == SLOWED_REPLICA
+            )
+            if snap["healthy"] and not snap["draining"]:
+                break
+            time.sleep(0.25)
+        assert snap["healthy"] and not snap["draining"], (
+            f"slowed replica never re-admitted after heal: {snap}"
+        )
+        deadline = time.monotonic() + 75.0  # the slow burn window is 60s
+        while time.monotonic() < deadline:
+            burn = serve_promote.fleet_burn(base)
+            if burn is None or burn <= 14.4:
+                break
+            time.sleep(1.0)
+        assert burn is None or burn <= 14.4, (
+            f"fleet burn never settled after healing the slowed replica: {burn}"
+        )
+        print(f"healed replica {SLOWED_REPLICA} (slow fault cleared, "
+              f"fleet burn settled at {0.0 if burn is None else burn:.2f})",
+              flush=True)
+
+        from moco_tpu.serve.promote import PromotionLedger
+
+        cand_bad = os.path.join(workdir, "cand_skewed")
+        cand_good = os.path.join(workdir, "cand_good")
+        print("promotion: building candidates (skewed re-init + compatible "
+              "nudge, both posing as step 1)...", flush=True)
+        # skewed: a different random init saved as "step 1" — embeds the
+        # probes into an unrelated space, the compat gates must catch it
+        serve_smoke.make_toy_checkpoint(cand_bad, seed=1, step=1)
+        _make_compatible_candidate(ckpt_dir, cand_good, step=1)
+
+        ledger_path = os.path.join(workdir, "promotions.jsonl")
+        ledger = PromotionLedger(ledger_path)
+        pargs = argparse.Namespace(
+            candidate_dir=cand_bad, live_dir=ckpt_dir, router=base,
+            probes=PROMOTE_PROBES, k=5,
+            floor_cosine=0.90, floor_overlap=0.60,
+            floor_feature_std=PROMOTE_FEATURE_STD_FLOOR,
+            max_ema_drift=0.50, floor_live_recall=None,
+            soak_s=1.0, swap_timeout_s=RESPAWN_DEADLINE_S,
+            burn_ceiling=14.4, poll_s=0.5,
+        )
+        verdict = serve_promote.promote_once(pargs, ledger)
+        assert verdict == "rejected", (
+            f"the skewed candidate cleared the gate battery: {verdict}"
+        )
+        rejected = [
+            r for r in ledger.read() if r["promotion/verdict"] == "rejected"
+        ]
+        assert rejected and rejected[-1]["promotion/failed_gate"] == "compat_cosine", (
+            f"rejection did not name the compat gate: {rejected}"
+        )
+        # the evidence is IN the ledger line: measured value vs floor
+        assert rejected[-1]["promotion/gate/compat_cosine"] < rejected[-1][
+            "promotion/floor/compat_cosine"
+        ], rejected[-1]
+        # ...and a rejected candidate never touched traffic
+        assert not _get(base + "/stats").get("fleet_serve/promotions"), (
+            "a rejected candidate reached the fleet"
+        )
+        print("promotion: skewed candidate rejected at the "
+              f"compat_cosine gate ({rejected[-1]['promotion/gate/compat_cosine']:.3f} "
+              f"vs floor {rejected[-1]['promotion/floor/compat_cosine']})", flush=True)
+
+        # the compatible candidate rolls out replica-by-replica under
+        # live traffic: zero dropped requests, and the version-skew
+        # gauge must pass through a mixed-fleet reading before settling
+        stop = threading.Event()
+        promo_failures: list[str] = []
+        skew_seen: list = []
+
+        def promo_background(ci: int) -> None:
+            j = 0
+            while not stop.is_set():
+                path = "/neighbors?k=3" if (ci + j) % 2 == 0 else "/embed"
+                j += 1
+                try:
+                    check_response(post(path, canned[1]), 1)
+                except Exception as e:
+                    with lock:
+                        promo_failures.append(repr(e))
+                time.sleep(0.05)
+
+        def skew_watcher() -> None:
+            while not stop.is_set():
+                try:
+                    s = _get(base + "/stats").get("fleet_serve/model_skew")
+                except Exception:
+                    s = None
+                if s is not None:
+                    with lock:
+                        skew_seen.append(int(s))
+                time.sleep(0.25)
+
+        pargs.candidate_dir = cand_good
+        promo_threads = [
+            threading.Thread(target=promo_background, args=(ci,)) for ci in range(2)
+        ] + [threading.Thread(target=skew_watcher)]
+        for t in promo_threads:
+            t.start()
+        try:
+            verdict = serve_promote.promote_once(pargs, ledger)
+        finally:
+            stop.set()
+            for t in promo_threads:
+                t.join(timeout=60)
+        assert verdict == "promoted", (
+            f"the compatible candidate did not promote: {verdict}"
+        )
+        assert not promo_failures, (
+            f"{len(promo_failures)} requests dropped during the staged "
+            f"rollout: {promo_failures[:5]}"
+        )
+        assert max(skew_seen, default=0) >= 1, (
+            "the rollout never showed a mixed-version fleet on "
+            "fleet_serve/model_skew"
+        )
+        promoted = [
+            r for r in ledger.read() if r["promotion/verdict"] == "promoted"
+        ]
+        assert promoted and promoted[-1]["promotion/step"] == 1, promoted
+        target_digest = promoted[-1]["promotion/digest"]
+        # every replica now serves the candidate (step + digest), and
+        # the router's skew gauge settles back to 0
+        for i in range(NUM_REPLICAS):
+            m = _get(sup.url(i) + "/admin/model")
+            assert m["model_step"] == 1 and m["model_digest"] == target_digest, (
+                f"replica {i} is not on the promoted encoder: {m}"
+            )
+        deadline = time.monotonic() + 60.0
+        skew = None
+        while time.monotonic() < deadline:
+            skew = _get(base + "/stats").get("fleet_serve/model_skew")
+            if skew == 0:
+                break
+            time.sleep(0.5)
+        assert skew == 0, f"fleet_serve/model_skew stuck at {skew} post-rollout"
+        stats = _get(base + "/stats")
+        assert stats.get("fleet_serve/promotions") == NUM_REPLICAS, stats
+        print(f"promotion: candidate {target_digest} promoted across "
+              f"{NUM_REPLICAS} replicas (skew peaked at "
+              f"{max(skew_seen)}, settled at 0, zero dropped requests)",
+              flush=True)
+        summary["promotion"] = {
+            "ledger": ledger_path,
+            "rejected_gate": rejected[-1]["promotion/failed_gate"],
+            "promoted_digest": target_digest,
+            "promoted_step": 1,
+            "skew_peak": max(skew_seen),
+        }
 
         # -- final gates ---------------------------------------------------
         stats = _get(base + "/stats")
@@ -500,6 +846,14 @@ def run_smoke(workdir: str, contract_coverage: bool = False) -> dict:
           f"{tm_summary['flow_events']} flow arrows, "
           f"{len(offline)} traces re-stitched offline", flush=True)
 
+    # -- freshness: an ingest stall must trip the fresh-burn alert ---------
+    # (after the trace merge: this leg's own trace stream lives in a
+    # subdir and must not enter the fleet's merged timeline)
+    summary["freshness"] = _freshness_stall_leg(workdir)
+    print(f"freshness: ingest stall tripped fresh_burn_fast "
+          f"(burn {summary['freshness']['fresh_burn_rate_5s']:.1f}, "
+          f"row age {summary['freshness']['row_age_max_s']:.1f}s)", flush=True)
+
     if recorder is not None:
         # validate each replica's serve/* stream too — with the recorder
         # still wired into obs/schema this doubles as validator coverage
@@ -523,10 +877,17 @@ def run_smoke(workdir: str, contract_coverage: bool = False) -> dict:
             + contract_cov.declared_route_gates("router")
         ))
         gate_faults = [f"slow@{s}" for s in decl.SERVE_STAGE_SITES] + [
-            "kill@replica"
+            "kill@replica",
+            # the freshness leg's chaos lever: the /ingest stall hook
+            "delay@ingest",
         ]
-        gate_validators = tuple(decl.SERVE_GATED_VALIDATORS) + tuple(
-            decl.FLEET_GATED_VALIDATORS
+        gate_validators = (
+            tuple(decl.SERVE_GATED_VALIDATORS)
+            + tuple(decl.FLEET_GATED_VALIDATORS)
+            # model identity / freshness gauges (every replica declares
+            # the objective) + the promotion ledger's verdict fields
+            + tuple(decl.QUALITY_GATED_VALIDATORS)
+            + tuple(decl.PROMOTION_GATED_VALIDATORS)
         )
         missing = contract_cov.check_coverage(
             cov,
@@ -565,7 +926,8 @@ def run_smoke(workdir: str, contract_coverage: bool = False) -> dict:
             sys.executable, "-m", "moco_tpu.analysis",
             "moco_tpu/serve/router.py", "moco_tpu/serve/fleet.py",
             "moco_tpu/serve/replica_main.py", "moco_tpu/serve/batcher.py",
-            "scripts/fleet_serve_smoke.py",
+            "moco_tpu/serve/promote.py",
+            "scripts/fleet_serve_smoke.py", "scripts/serve_promote.py",
             "--no-baseline",
         ],
         cwd=repo, capture_output=True, text=True,

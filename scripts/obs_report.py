@@ -23,6 +23,12 @@ present):
   burn-rate curve per window (`serve/burn_rate_*` sparkline), and the
   top-N slowest requests with their full stage waterfalls from the
   newest flight-recorder dump (`flight_*.json`) when one exists;
+- model quality & freshness: the served model's identity (checkpoint
+  step + params digest + last ingested step), compatibility gauges
+  (`serve/compat_cosine`, `serve/recall_overlap`), the index row-age
+  trend vs the declared freshness objective with the
+  `serve/fresh_burn_rate_*` sparklines, the fleet's version-skew
+  trend, and every `promotions.jsonl` verdict with its failing gate;
 - alerts: every fired alert from alerts.jsonl, grouped by rule;
 - training-health trends: loss/accuracy, EMA drift, InfoNCE pos/neg
   logit margin, feature-collapse gauges, queue staleness — first→last
@@ -115,6 +121,30 @@ def _flight_dumps(workdir: str | None, role: str | None) -> list[tuple[str, dict
             continue
         if (dump.get("role") == "router") == (role == "router"):
             out.append((path, dump))
+    return out
+
+
+def _promotion_ledger(workdir: str | None) -> list[dict]:
+    """Parsed `promotions.jsonl` verdict lines (oldest first), [] when
+    the run has no promotion ledger. Tolerant parse — the report must
+    render even next to a half-written ledger."""
+    if not workdir:
+        return []
+    path = os.path.join(workdir, "promotions.jsonl")
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("event") == "promotion":
+                out.append(rec)
     return out
 
 
@@ -346,6 +376,77 @@ def render_report(
                     w(f"- `{wf.get('request_id', '?')}` "
                       f"({wf.get('total_ms', 0):.0f} ms, {wf.get('rows', '?')} rows): "
                       f"{stages_str}")
+        w("")
+
+    # -- model quality & freshness (the train->serve loop) ----------------
+    quality_lines = [
+        r for r in records
+        if any(
+            k in r
+            for k in (
+                "serve/model_step", "serve/compat_cosine", "serve/fresh_max_age_s",
+            )
+        )
+    ]
+    promotions = _promotion_ledger(workdir)
+    if quality_lines or promotions:
+        w("## Model quality & freshness")
+        w("")
+        last = quality_lines[-1] if quality_lines else {}
+        if last.get("serve/model_step") is not None or last.get("serve/model_digest"):
+            w(f"served model: step {_fmt(last.get('serve/model_step'))}, "
+              f"digest `{_fmt(last.get('serve/model_digest'))}`, "
+              f"last ingested block from step "
+              f"{_fmt(last.get('serve/ingest_ckpt_step'))}")
+        for key in ("serve/compat_cosine", "serve/recall_overlap"):
+            t = _trend(quality_lines, key)
+            if t is not None:
+                w(f"- `{key}`: {t}")
+        fresh_obj = last.get("serve/fresh_max_age_s")
+        if isinstance(fresh_obj, (int, float)):
+            w(f"- freshness objective: rows no older than {_fmt(fresh_obj)}s")
+            for key in ("serve/row_age_max_s", "serve/row_age_mean_s"):
+                t = _trend(quality_lines, key)
+                if t is not None:
+                    w(f"- `{key}`: {t}")
+        fresh_keys = sorted(
+            {k for r in quality_lines for k in r
+             if k.startswith("serve/fresh_burn_rate_")}
+        )
+        for key in fresh_keys:
+            vals = [r[key] for r in quality_lines
+                    if isinstance(r.get(key), (int, float))]
+            if vals:
+                w(f"- `{key}`: {_spark(vals)}  last {_fmt(vals[-1])} "
+                  f"(max {_fmt(max(vals))}; >1 = the index is going stale "
+                  "faster than the objective sustains)")
+        skew = _trend(
+            [r for r in records if "fleet_serve/model_skew" in r],
+            "fleet_serve/model_skew",
+        )
+        if skew is not None:
+            w(f"- `fleet_serve/model_skew`: {skew} "
+              "(0 = every replica serves the same encoder)")
+        if promotions:
+            w("")
+            w("promotion ledger (append-only, newest last):")
+            for p in promotions[-10:]:
+                gate = p.get("promotion/failed_gate")
+                detail = ""
+                if gate:
+                    val = p.get(f"promotion/gate/{gate}")
+                    floor = p.get(f"promotion/floor/{gate}")
+                    detail = f" — failed `{gate}`" + (
+                        f" ({_fmt(val)} vs floor {_fmt(floor)})"
+                        if val is not None else ""
+                    )
+                w(f"- step {p.get('promotion/step', '?')} "
+                  f"`{_fmt(p.get('promotion/digest'))}`: "
+                  f"**{p.get('promotion/verdict', '?')}** "
+                  f"at {p.get('promotion/stage', '?')}{detail}")
+            if len(promotions) > 10:
+                w(f"- ... {len(promotions) - 10} earlier entries in "
+                  "promotions.jsonl")
         w("")
 
     # -- fleet tracing (stitched distributed waterfalls) ------------------

@@ -65,6 +65,17 @@ TIER_MIN_RATIO_CPU = 0.75
 # their qps delta carries scheduler jitter far beyond the tracing cost.
 TRACE_OVERHEAD_CAP_ACCEL = 5.0
 TRACE_OVERHEAD_CAP_CPU = 25.0
+# promotion-swap caps for the serving series (ISSUE 19): the measured
+# pause of one staged-rollout step (drain -> same-port swap -> readmit;
+# checkpoint restore/AOT re-warm excluded — see bench.py) and the
+# client p99 across the swap window. The CPU smoke's closed-loop
+# clients queue on one core while a replica is out of rotation, so its
+# caps are about catching pathology (a wedged drain, a readmit
+# timeout), not about the accelerator claim.
+PROMOTE_PAUSE_CAP_ACCEL = 5000.0
+PROMOTE_PAUSE_CAP_CPU = 15000.0
+PROMOTE_SWAP_P99_CAP_ACCEL = 5000.0
+PROMOTE_SWAP_P99_CAP_CPU = 30000.0
 
 # bench-JSON fields copied into a ledger entry when present
 TRACKED_FIELDS = (
@@ -242,6 +253,40 @@ def check(ledger_path: str, input_path: str, threshold: float | None = None) -> 
                 print(
                     f"perf gate [PASS] {serving['metric']}: {label} "
                     f"overhead {overhead:.1f}% (cap {cap:g}%)"
+                )
+        # promotion-swap overhead (ISSUE 19): one staged-rollout step
+        # through the router must stay cheap — a bounded pause until
+        # the swapped replica re-admits with its new digest, a bounded
+        # client p99 across the swap window, and ZERO failed requests
+        # (one dropped request during a swap is the exact failure the
+        # drain path exists to prevent)
+        on_cpu = "cpu_smoke" in serving["metric"]
+        for field, label, cap in (
+            (
+                "promote_pause_ms",
+                "promotion-swap pause",
+                PROMOTE_PAUSE_CAP_CPU if on_cpu else PROMOTE_PAUSE_CAP_ACCEL,
+            ),
+            (
+                "promote_swap_p99_ms",
+                "p99 during swap",
+                PROMOTE_SWAP_P99_CAP_CPU if on_cpu else PROMOTE_SWAP_P99_CAP_ACCEL,
+            ),
+            ("promote_swap_failures", "swap-window failures", 0.0),
+        ):
+            value = serving.get(field)
+            if value is None:
+                continue
+            if value > cap:
+                print(
+                    f"perf gate [FAIL] {serving['metric']}: {label} "
+                    f"{value:g} above the {cap:g} cap"
+                )
+                rc |= 1
+            else:
+                print(
+                    f"perf gate [PASS] {serving['metric']}: {label} "
+                    f"{value:g} (cap {cap:g})"
                 )
         # quantized-engine tiers (ISSUE 11): both tiers must hold the
         # embedding-cosine floor vs f32 (hard, every platform — speed

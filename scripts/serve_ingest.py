@@ -71,10 +71,15 @@ def fresh_rows(queue: np.ndarray, old_ptr, new_ptr: int) -> np.ndarray:
 
 def post_rows(
     server: str, rows: np.ndarray, block: int = DEFAULT_BLOCK,
-    site: str = "ingest.post",
+    site: str = "ingest.post", ckpt_step: int = None,
 ) -> int:
     """POST `rows` to the replica's /ingest in bounded blocks; returns
     the replica's reported index row count after the last block.
+    `ckpt_step` (the checkpoint step the rows came from) travels as the
+    `X-Ckpt-Step` header so the replica's `serve/ingest_ckpt_step`
+    gauge tracks WHICH encoder's dictionary it is serving — the
+    freshness SLO's `serve/row_age_max_s` is wall-clock, this is the
+    training-step twin.
 
     Each POST runs through the `utils/retry.py` backoff layer (`site`,
     counted in the per-site io_retries ledger — fanout mode names one
@@ -85,10 +90,13 @@ def post_rows(
     from moco_tpu.utils import retry
 
     def _post(chunk: np.ndarray) -> int:
+        headers = {"X-Rows-Shape": f"{chunk.shape[0]},{chunk.shape[1]}"}
+        if ckpt_step is not None:
+            headers["X-Ckpt-Step"] = str(int(ckpt_step))
         req = urllib.request.Request(
             server.rstrip("/") + "/ingest",
             data=chunk.tobytes(),
-            headers={"X-Rows-Shape": f"{chunk.shape[0]},{chunk.shape[1]}"},
+            headers=headers,
         )
         with _urlopen(req, timeout=60) as r:
             return json.loads(r.read())["index_rows"]
@@ -110,7 +118,10 @@ def discover_replicas(router: str) -> dict:
     return {int(rep["index"]): rep["url"] for rep in body["replicas"]}
 
 
-def fanout_rows(router: str, rows: np.ndarray, block: int = DEFAULT_BLOCK) -> dict:
+def fanout_rows(
+    router: str, rows: np.ndarray, block: int = DEFAULT_BLOCK,
+    ckpt_step: int = None,
+) -> dict:
     """POST `rows` to every replica behind `router`, each under its own
     retry site (`ingest.post.r<i>`). Returns {index: index_rows | None}
     — None marks a replica whose retries were exhausted (logged; the
@@ -119,7 +130,8 @@ def fanout_rows(router: str, rows: np.ndarray, block: int = DEFAULT_BLOCK) -> di
     for index, url in sorted(discover_replicas(router).items()):
         try:
             results[index] = post_rows(
-                url, rows, block, site=f"ingest.post.r{index}"
+                url, rows, block, site=f"ingest.post.r{index}",
+                ckpt_step=ckpt_step,
             )
         except OSError as e:
             print(
@@ -150,7 +162,7 @@ def poll_once(
     rows = fresh_rows(queue, seen.get("ptr"), new_ptr)
     if rows.shape[0]:
         if fanout:
-            results = fanout_rows(server, rows, block)
+            results = fanout_rows(server, rows, block, ckpt_step=step)
             summary = ", ".join(
                 f"r{i}={'FAILED' if n is None else n}"
                 for i, n in sorted(results.items())
@@ -161,7 +173,7 @@ def poll_once(
                 flush=True,
             )
         else:
-            index_rows = post_rows(server, rows, block)
+            index_rows = post_rows(server, rows, block, ckpt_step=step)
             print(
                 f"step {step}: ingested {rows.shape[0]} fresh rows "
                 f"(replica index_rows={index_rows})",

@@ -121,10 +121,12 @@ QUANT_REQUESTS = 40
 QUANT_COSINE_FLOOR = float(os.environ.get("SERVE_SMOKE_QUANT_COSINE_FLOOR", 0.99))
 
 
-def make_toy_checkpoint(workdir: str):
+def make_toy_checkpoint(workdir: str, seed: int = 0, step: int = 0):
     """A pretraining checkpoint exactly as the train driver saves them
     (config-carrying extras), from a freshly-initialized tiny model —
-    serving correctness doesn't need trained weights."""
+    serving correctness doesn't need trained weights. `seed`/`step` let
+    the fleet smoke mint deliberately-incompatible candidates (a
+    different init posing as a later step) for the promotion gates."""
     import jax
     import jax.numpy as jnp
 
@@ -156,12 +158,12 @@ def make_toy_checkpoint(workdir: str):
     encoder = build_encoder(config.moco)
     tx = build_optimizer(config.optim, steps_per_epoch=1)
     state = create_state(
-        jax.random.PRNGKey(0), config, encoder, tx,
+        jax.random.PRNGKey(seed), config, encoder, tx,
         jnp.zeros((1, IMAGE_SIZE, IMAGE_SIZE, 3), jnp.float32),
     )
     mgr = CheckpointManager(workdir)
     mgr.save(
-        0, state,
+        step, state,
         extra={"epoch": 0, "config": config_to_dict(config), "num_data": 1},
         force=True,
     )

@@ -15,7 +15,8 @@ class Handler:
         path = self.path.split("?")[0]
         if path == "/ingest":
             shape = self.headers.get("X-Rows-Shape", "")
-            self._json(200, {"shape": shape})
+            ckpt_step = self.headers.get("X-Ckpt-Step")
+            self._json(200, {"shape": shape, "ckpt_step": ckpt_step})
 
     def _json(self, code, obj):
         pass

@@ -1,0 +1,527 @@
+"""Model promotion & freshness (ISSUE 19): the embedding-space
+compatibility scorer (a rotated/skewed candidate is rejected with the
+gate named in the ledger), freshness burn-rate math + window eviction
+and the index row-age stamps behind it, the staged-rollout state
+machine including auto-rollback on a burn breach, the append-only
+audit-ledger schema, and the router's version-skew gauge +
+/admin/promote endpoint."""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from moco_tpu.obs import quality, schema
+from moco_tpu.obs.slo import FreshnessBurnTracker, fresh_alert_spec
+from moco_tpu.serve.index import EmbeddingIndex
+from moco_tpu.serve.promote import (
+    PromotionLedger,
+    StagedRollout,
+    ledger_record,
+    run_gate_battery,
+)
+
+
+# -- fakes ---------------------------------------------------------------
+
+
+class LinearEngine:
+    """Engine-shaped fake: flattens the probe images, projects through a
+    fixed matrix, L2-normalizes — so two engines sharing a matrix are
+    'compatible' and a rotated matrix is a skewed checkpoint."""
+
+    def __init__(self, mat: np.ndarray):
+        self.mat = np.asarray(mat, np.float32)
+
+    def embed(self, images):
+        x = np.asarray(images, np.float32).reshape(images.shape[0], -1)
+        x = x[:, : self.mat.shape[0]]
+        e = x @ self.mat
+        e /= np.linalg.norm(e, axis=1, keepdims=True) + 1e-9
+        return e.astype(np.float32), [(images.shape[0], images.shape[0])]
+
+
+def _engines(dim=8, rotate=False, seed=0):
+    rng = np.random.RandomState(seed)
+    base = rng.randn(dim, dim).astype(np.float32)
+    live = LinearEngine(base)
+    if rotate:
+        q, _ = np.linalg.qr(rng.randn(dim, dim))
+        cand = LinearEngine(base @ q.astype(np.float32))
+    else:
+        cand = LinearEngine(base + 0.005 * rng.randn(dim, dim).astype(np.float32))
+    return live, cand
+
+
+def _live_index(dim=8, rows=64, seed=1):
+    rng = np.random.RandomState(seed)
+    emb = rng.randn(rows, dim).astype(np.float32)
+    emb /= np.linalg.norm(emb, axis=1, keepdims=True)
+    idx = EmbeddingIndex(dim=dim, capacity=rows)
+    idx.snapshot(emb, now=0.0)
+    return idx
+
+
+# -- compatibility scorer ------------------------------------------------
+
+
+def test_params_digest_stable_and_content_sensitive():
+    params = {"backbone": {"w": np.arange(6.0).reshape(2, 3)}, "head": {"b": np.ones(3)}}
+    same = {"head": {"b": np.ones(3)}, "backbone": {"w": np.arange(6.0).reshape(2, 3)}}
+    assert quality.params_digest(params) == quality.params_digest(same)
+    bumped = {"backbone": {"w": np.arange(6.0).reshape(2, 3) + 1e-6}, "head": {"b": np.ones(3)}}
+    assert quality.params_digest(params) != quality.params_digest(bumped)
+    # shape/dtype changes disagree even when bytes could collide
+    reshaped = {"backbone": {"w": np.arange(6.0).reshape(3, 2)}, "head": {"b": np.ones(3)}}
+    assert quality.params_digest(params) != quality.params_digest(reshaped)
+
+
+def test_compat_cosine_identity_vs_rotation():
+    live, cand = _engines(rotate=False)
+    probes = quality.synthetic_probes(16, 4)
+    a, _ = live.embed(probes)
+    b, _ = cand.embed(probes)
+    assert quality.compat_cosine(a, a) == pytest.approx(1.0, abs=1e-5)
+    assert quality.compat_cosine(a, b) > 0.95
+    live, rot = _engines(rotate=True)
+    r, _ = rot.embed(probes)
+    assert quality.compat_cosine(a, r) < 0.8
+    with pytest.raises(ValueError):
+        quality.compat_cosine(a, a[:-1])
+
+
+def test_recall_overlap_identity_is_one_rotation_is_not():
+    live, _ = _engines()
+    _, rot = _engines(rotate=True)
+    idx = _live_index()
+    probes = quality.synthetic_probes(16, 4)
+    a, _ = live.embed(probes)
+    r, _ = rot.embed(probes)
+    assert quality.recall_overlap(a, a, idx, k=5) == pytest.approx(1.0)
+    assert quality.recall_overlap(a, r, idx, k=5) < 0.5
+    with pytest.raises(ValueError):
+        quality.recall_overlap(a, a, EmbeddingIndex(dim=8, capacity=4))
+
+
+def test_synthetic_probes_deterministic_uint8():
+    a = quality.synthetic_probes(8, 16, seed=3)
+    b = quality.synthetic_probes(8, 16, seed=3)
+    assert a.dtype == np.uint8 and a.shape == (8, 16, 16, 3)
+    assert np.array_equal(a, b)
+    assert not np.array_equal(a, quality.synthetic_probes(8, 16, seed=4))
+
+
+def test_model_and_compat_payloads_are_schema_valid():
+    line = {"step": 0, "time": 1.0}
+    line.update(quality.model_payload(7, "abc123"))
+    line.update(quality.compat_payload(0.98, 0.9))
+    assert schema.validate_line(line) == []
+    line.update(quality.model_payload(None, None))
+    line.update(quality.compat_payload(None, None))
+    assert schema.validate_line(line) == []
+    bad = {"step": 0, "time": 1.0, "serve/compat_cosine": 1.5}
+    assert schema.validate_line(bad)
+
+
+# -- gate battery --------------------------------------------------------
+
+
+def test_gate_battery_accepts_compatible_candidate():
+    live, cand = _engines()
+    res = run_gate_battery(live, cand, quality.synthetic_probes(16, 4),
+                           index=_live_index(), k=5)
+    assert res["ok"] and res["failed_gate"] is None
+    assert set(res["gates"]) >= {"compat_cosine", "recall_overlap", "feature_std"}
+    assert all(g["ok"] for g in res["gates"].values())
+    assert schema.validate_line({"step": 0, "time": 1.0, **res["compat"]}) == []
+
+
+def test_gate_battery_rejects_rotated_checkpoint_naming_the_gate():
+    live, rot = _engines(rotate=True)
+    res = run_gate_battery(live, rot, quality.synthetic_probes(16, 4),
+                           index=_live_index(), k=5)
+    assert not res["ok"]
+    # the FIRST failing gate is named — the ledger line carries it
+    assert res["failed_gate"] == "compat_cosine"
+    assert not res["gates"]["compat_cosine"]["ok"]
+    assert res["gates"]["compat_cosine"]["value"] < res["gates"]["compat_cosine"]["floor"]
+
+
+def test_gate_battery_catches_dimensional_collapse():
+    live, _ = _engines()
+
+    class Collapsed:
+        def embed(self, images):
+            e = np.tile(np.eye(1, 8, dtype=np.float32), (images.shape[0], 1))
+            return e, [(images.shape[0], images.shape[0])]
+
+    res = run_gate_battery(
+        live, Collapsed(), quality.synthetic_probes(16, 4),
+        # a collapsed embedding keeps cosine with nothing pinned; gate
+        # only the collapse detector so the failure attribution is exact
+        floors={"compat_cosine": -1.0},
+    )
+    assert not res["ok"] and res["failed_gate"] == "feature_std"
+
+
+def test_gate_battery_ema_drift_ceiling():
+    live, cand = _engines()
+    probes = quality.synthetic_probes(8, 4)
+    pq = {"backbone": {"w": np.ones((3, 3), np.float32)}}
+    pk_close = {"backbone": {"w": np.ones((3, 3), np.float32) * 1.001}}
+    pk_torn = {"backbone": {"w": -np.ones((3, 3), np.float32)}}
+    ok = run_gate_battery(live, cand, probes, cand_params_q=pq, cand_params_k=pk_close)
+    assert ok["gates"]["ema_drift_max"]["ok"]
+    torn = run_gate_battery(live, cand, probes, cand_params_q=pq, cand_params_k=pk_torn)
+    assert not torn["gates"]["ema_drift_max"]["ok"]
+    assert torn["failed_gate"] == "ema_drift_max"
+
+
+def test_gate_battery_live_recall_floor_is_opt_in():
+    live, cand = _engines()
+    probes = quality.synthetic_probes(8, 4)
+    res = run_gate_battery(live, cand, probes, live_recall=0.2)
+    assert "live_recall" not in res["gates"]  # no floor declared
+    res = run_gate_battery(live, cand, probes,
+                           floors={"live_recall": 0.5}, live_recall=0.2)
+    assert res["failed_gate"] == "live_recall"
+
+
+# -- audit ledger --------------------------------------------------------
+
+
+def test_ledger_lines_are_schema_strict_and_append_only(tmp_path):
+    led = PromotionLedger(os.path.join(tmp_path, "promotions.jsonl"))
+    live, rot = _engines(rotate=True)
+    res = run_gate_battery(live, rot, quality.synthetic_probes(16, 4),
+                           index=_live_index(), k=5)
+    led.append(ledger_record(3, "rejected", "gates", digest="d3",
+                             failed_gate=res["failed_gate"],
+                             gates=res["gates"], compat=res["compat"]))
+    led.append(ledger_record(4, "accepted", "gates", digest="d4"))
+    led.append(ledger_record(4, "promoted", "rollout", digest="d4"))
+    recs = led.read()
+    assert [r["promotion/verdict"] for r in recs] == [
+        "rejected", "accepted", "promoted",
+    ]
+    # the rejected line names the killing gate and carries its evidence
+    assert recs[0]["promotion/failed_gate"] == "compat_cosine"
+    assert recs[0]["promotion/gate/compat_cosine"] < recs[0]["promotion/floor/compat_cosine"]
+    assert recs[0]["promotion/gate_ok/compat_cosine"] == 0
+    assert recs[0]["event"] == "promotion"
+    # every line on disk passes the strict schema independently
+    with open(led.path) as f:
+        assert schema.validate_lines(f) == []
+
+
+def test_ledger_rejects_unschemad_records(tmp_path):
+    led = PromotionLedger(os.path.join(tmp_path, "promotions.jsonl"))
+    with pytest.raises(ValueError):
+        ledger_record(1, "shipped", "gates")  # unknown verdict
+    rec = ledger_record(1, "accepted", "gates")
+    del rec["time"]  # schema requires step+time
+    with pytest.raises(ValueError):
+        led.append(rec)
+    rec2 = ledger_record(1, "accepted", "gates")
+    rec2["promotion/gate/compat_cosine"] = float("nan")
+    with pytest.raises(ValueError):
+        led.append(rec2)  # allow_nan=False: a NaN never lands on disk
+    assert led.read() == []  # nothing landed
+
+
+# -- freshness SLO -------------------------------------------------------
+
+
+def test_fresh_burn_math_and_window_eviction():
+    t = FreshnessBurnTracker(max_age_s=5.0, objective=0.9, windows=(10, 100))
+    for i in range(10):
+        t.record(2.0, now=1000 + i)  # fresh
+    assert t.burn_rates(now=1009)[10] == pytest.approx(0.0)
+    for i in range(10):
+        t.record(60.0, now=1010 + i)  # stale: every observation burns
+    rates = t.burn_rates(now=1019)
+    assert rates[10] == pytest.approx(1.0 / 0.1, rel=1e-6)  # 100% bad / 10% budget
+    assert rates[100] == pytest.approx(0.5 / 0.1, rel=1e-6)  # half the window bad
+    # eviction: past the long window the old buckets are gone
+    t.record(2.0, now=1500)
+    assert t.burn_rates(now=1500)[100] == pytest.approx(0.0)
+    # an empty index (no stamped rows) is not stale; a silent window is None
+    t2 = FreshnessBurnTracker(max_age_s=5.0, windows=(10,))
+    t2.record(None, now=0)
+    assert t2.burn_rates(now=0)[10] == pytest.approx(0.0)
+    assert t2.burn_rates(now=100)[10] is None
+    with pytest.raises(ValueError):
+        FreshnessBurnTracker(max_age_s=0.0)
+
+
+def test_fresh_payload_and_alert_spec():
+    t = FreshnessBurnTracker(max_age_s=3.0, windows=(10, 60))
+    t.record(10.0, now=100)
+    p = t.payload(now=100)
+    assert p["serve/fresh_max_age_s"] == 3.0
+    assert p["serve/fresh_burn_rate_10s"] > 0
+    assert schema.validate_line({"step": 0, "time": 1.0, **p}) == []
+    spec = fresh_alert_spec(windows=(10, 60))
+    assert "name=fresh_burn_fast:field=serve/fresh_burn_rate_10s" in spec
+    assert "name=fresh_burn_slow:field=serve/fresh_burn_rate_60s" in spec
+    from moco_tpu.obs import alerts
+
+    assert len(alerts.parse_rules(spec)) == 2
+
+
+def test_index_row_age_stamps_follow_snapshot_and_add():
+    idx = EmbeddingIndex(dim=4, capacity=8)
+    assert idx.row_age_stats(now=10.0) == {
+        "row_age_max_s": None, "row_age_mean_s": None,
+    }
+    rows = np.eye(4, dtype=np.float32)
+    idx.snapshot(rows, now=100.0)
+    st = idx.row_age_stats(now=130.0)
+    assert st["row_age_max_s"] == pytest.approx(30.0)
+    assert st["row_age_mean_s"] == pytest.approx(30.0)
+    # a fresh ingest stamps exactly the rows it wrote (FIFO append here)
+    idx.add(rows[:2], now=128.0)
+    st = idx.row_age_stats(now=130.0)
+    assert idx.count == 6
+    assert st["row_age_max_s"] == pytest.approx(30.0)
+    assert st["row_age_mean_s"] == pytest.approx((30.0 * 4 + 2.0 * 2) / 6)
+    # wrap-around overwrites re-stamp the overwritten slots
+    idx.add(np.tile(rows, (1, 1))[:4], now=129.0)  # fills 6,7 then wraps to 0,1
+    st = idx.row_age_stats(now=130.0)
+    assert idx.count == 8
+    assert st["row_age_max_s"] == pytest.approx(30.0)  # rows 2,3 still old
+    assert st["row_age_mean_s"] == pytest.approx(
+        (30.0 * 2 + 2.0 * 2 + 1.0 * 4) / 8
+    )
+    # ages clamp at zero (a clock hiccup never reports negative age)
+    assert idx.row_age_stats(now=0.0)["row_age_max_s"] == 0.0
+
+
+# -- staged rollout ------------------------------------------------------
+
+
+class _Fleet:
+    """Swap/status/burn fakes with a deterministic clock: a swap takes
+    `swap_lag_polls` sleep ticks to land, like a real drain/restart."""
+
+    def __init__(self, n=3, swap_lag_polls=1):
+        self.n = n
+        self.digest = {i: "old" for i in range(n)}
+        self.pending: dict = {}  # replica -> [polls_left, target_digest]
+        self.swap_lag_polls = swap_lag_polls
+        self.swaps: list = []
+        self.backs: list = []
+        self.t = 0.0
+        self.burn_value = 0.0
+
+    def clock(self):
+        return self.t
+
+    def sleep(self, s):
+        self.t += s
+        for i in list(self.pending):
+            self.pending[i][0] -= 1
+            if self.pending[i][0] <= 0:
+                self.digest[i] = self.pending.pop(i)[1]
+
+    def swap(self, i):
+        self.swaps.append(i)
+        self.pending[i] = [self.swap_lag_polls, "new"]
+
+    def swap_back(self, i):
+        self.backs.append(i)
+        self.pending[i] = [self.swap_lag_polls, "old"]
+
+    def status(self, i):
+        return {
+            "healthy": True, "draining": i in self.pending,
+            "drain_phase": "restarting" if i in self.pending else None,
+            "model_digest": self.digest[i],
+        }
+
+    def burn(self):
+        return self.burn_value
+
+
+def test_rollout_promotes_one_replica_at_a_time():
+    f = _Fleet(n=3)
+    out = StagedRollout(
+        3, f.swap, f.status, burn=f.burn, swap_back=f.swap_back,
+        target_digest="new", soak_s=0.5, poll_s=0.1,
+        sleep=f.sleep, clock=f.clock,
+    ).run()
+    assert out["verdict"] == "promoted" and out["swapped"] == [0, 1, 2]
+    assert f.swaps == [0, 1, 2] and f.backs == []
+    assert all(d == "new" for d in f.digest.values())
+
+
+def test_rollout_burn_breach_rolls_everything_back():
+    f = _Fleet(n=3)
+
+    def burn_after_second_swap():
+        # the fleet sours once the candidate reaches replica 1
+        return 99.0 if f.digest[1] == "new" else 0.2
+
+    out = StagedRollout(
+        3, f.swap, f.status, burn=burn_after_second_swap,
+        swap_back=f.swap_back, target_digest="new", soak_s=0.5, poll_s=0.1,
+        sleep=f.sleep, clock=f.clock, burn_ceiling=14.4,
+    ).run()
+    assert out["verdict"] == "rolled_back"
+    assert out["reason"] == "burn_breach" and out["burn"] == 99.0
+    assert out["replica"] == 1 and out["swapped"] == [0, 1]
+    # every touched replica went back, replica 2 never swapped
+    assert f.backs == [0, 1] and f.swaps == [0, 1]
+    assert f.digest == {0: "old", 1: "old", 2: "old"}
+
+
+def test_rollout_swap_timeout_rolls_back():
+    f = _Fleet(n=2)
+
+    def never_lands(i):
+        f.swaps.append(i)  # the swap starts but the digest never flips
+
+    out = StagedRollout(
+        2, never_lands, f.status, burn=f.burn, swap_back=f.swap_back,
+        target_digest="new", soak_s=0.1, swap_timeout_s=1.0, poll_s=0.2,
+        sleep=f.sleep, clock=f.clock,
+    ).run()
+    assert out["verdict"] == "rolled_back" and out["reason"] == "swap_timeout"
+    assert out["replica"] == 0 and out["swapped"] == []
+    assert f.backs == [0]  # the half-swapped replica is still reverted
+
+
+def test_rollout_none_burn_is_not_a_breach():
+    f = _Fleet(n=1)
+    out = StagedRollout(
+        1, f.swap, f.status, burn=lambda: None, swap_back=f.swap_back,
+        target_digest="new", soak_s=0.3, poll_s=0.1,
+        sleep=f.sleep, clock=f.clock,
+    ).run()
+    assert out["verdict"] == "promoted"
+
+
+# -- router: version skew + /admin/promote -------------------------------
+
+
+def _wait(pred, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return pred()
+
+
+def test_router_model_skew_and_fresh_burn_aggregates():
+    from moco_tpu.serve.router import FleetRouter
+    from tests.test_router import FakeReplica
+
+    fakes = [FakeReplica(0), FakeReplica(1)]
+    fakes[0].set(stats_extra={
+        "serve/model_step": 5, "serve/model_digest": "aaa",
+        "serve/fresh_burn_rate_60s": 0.5,
+    })
+    fakes[1].set(stats_extra={
+        "serve/model_step": 7, "serve/model_digest": "bbb",
+        "serve/fresh_burn_rate_60s": 1.5,
+    })
+    router = FleetRouter(
+        replica_urls=[f.url for f in fakes], slo_ms=1000.0,
+        health_interval_s=0.1,
+    )
+    try:
+        assert _wait(lambda: router.stats()["fleet_serve/model_skew"] == 1)
+        st = router.stats()
+        assert st["fleet_serve/fresh_burn_rate_60s_max"] == pytest.approx(1.5)
+        assert st["fleet_serve/fresh_burn_rate_60s_min"] == pytest.approx(0.5)
+        assert st["fleet_serve/fresh_burn_rate_60s_mean"] == pytest.approx(1.0)
+        # /admin/replicas snapshots carry the served version per replica
+        with urllib.request.urlopen(
+            f"http://{router.host}:{router.port}/admin/replicas", timeout=5
+        ) as r:
+            snaps = json.loads(r.read())["replicas"]
+        assert {s["model_digest"] for s in snaps} == {"aaa", "bbb"}
+        assert {s["model_step"] for s in snaps} == {5, 7}
+        # skew heals when the fleet converges
+        fakes[1].set(stats_extra={
+            "serve/model_step": 5, "serve/model_digest": "aaa",
+        })
+        assert _wait(lambda: router.stats()["fleet_serve/model_skew"] == 0)
+    finally:
+        router.close()
+        for f in fakes:
+            f.close()
+
+
+def test_router_admin_promote_requires_supervisor_then_swaps():
+    from moco_tpu.serve.router import FleetRouter
+    from tests.test_router import FakeReplica
+
+    fakes = [FakeReplica(0), FakeReplica(1)]
+
+    class FakeSupervisor:
+        def __init__(self):
+            self.ckpt_dirs: list = []
+            self.restarts: list = []
+
+        def set_ckpt_dir(self, path):
+            self.ckpt_dirs.append(path)
+
+        def restart_replica(self, index):
+            self.restarts.append(index)
+
+    def _promote(router, i, ckpt="/run/candidate dir"):
+        from urllib.parse import quote
+
+        req = urllib.request.Request(
+            f"http://{router.host}:{router.port}"
+            f"/admin/promote?replica={i}&ckpt_dir={quote(ckpt, safe='')}",
+            data=b"",
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, json.loads(r.read())
+
+    bare = FleetRouter(
+        replica_urls=[f.url for f in fakes], slo_ms=1000.0,
+        health_interval_s=0.1,
+    )
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _promote(bare, 0)
+        assert e.value.code == 409  # no supervisor: promotion refused
+    finally:
+        bare.close()
+
+    sup = FakeSupervisor()
+    router = FleetRouter(
+        replica_urls=[f.url for f in fakes], slo_ms=1000.0,
+        health_interval_s=0.1, supervisor=sup,
+    )
+    try:
+        status, body = _promote(router, 1)
+        assert status == 202 and body["accepted"]
+        # the swap retargeted the supervisor (percent-decoded) and the
+        # drain worker restarted exactly that replica through it
+        assert sup.ckpt_dirs == ["/run/candidate dir"]
+        assert _wait(lambda: sup.restarts == [1])
+        assert _wait(
+            lambda: router.stats()["fleet_serve/replicas_healthy"] == 2
+        )
+        # bad requests are 400s, not silent no-ops
+        for q in ("replica=1", "ckpt_dir=/x", "replica=9&ckpt_dir=/x"):
+            req = urllib.request.Request(
+                f"http://{router.host}:{router.port}/admin/promote?" + q,
+                data=b"",
+            )
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req, timeout=10)
+            assert e.value.code == 400
+    finally:
+        router.close()
+        for f in fakes:
+            f.close()

@@ -128,6 +128,7 @@ class FakeReplica:
         self.requests = 0
         self.traced = 0
         self.ingested = 0
+        self.ingest_ckpt_step = None
         self.draining = False
         self.stats_extra: dict = {}
         outer = self
@@ -192,9 +193,12 @@ class FakeReplica:
                     self._json(200, out)
                 elif path == "/ingest":
                     shape = self.headers.get("X-Rows-Shape", "0,0").split(",")
+                    ckpt_step = self.headers.get("X-Ckpt-Step")
                     with outer._lock:
                         outer.ingested += int(shape[0])
                         n = outer.ingested
+                        if ckpt_step is not None:
+                            outer.ingest_ckpt_step = int(ckpt_step)
                     self._json(200, {"index_rows": n, "ingested_rows": n})
                 elif path == "/admin/drain":
                     with outer._lock:
