@@ -406,14 +406,21 @@ def main() -> None:
     # the analytic at-rest state bytes are the clean A/B signal), and
     # the analytic comms bytes/step from the per-bucket ledger.
     zero_ab = None
+    zero_legs = (("zero1", 1, False), ("zero23", 3, False), ("zero_layer", 3, True))
     if os.environ.get("BENCH_SKIP_ZERO"):
         _skip("zero_ab", "BENCH_SKIP_ZERO set")
     elif n_dev < 2:
-        _skip(
-            "zero_ab",
+        reason = (
             f"single-device mesh ({n_dev} chip): ZeRO shards over the data axis "
-            "(scripts/fleet_smoke.py covers the fake-8-device A/B)",
+            "(scripts/fleet_smoke.py covers the fake-8-device A/B)"
         )
+        _skip("zero_ab", reason)
+        # Sub-leg-granular skip record: CPU-smoke rounds previously wrote
+        # a bare null here, so the perf trajectory could not say WHICH
+        # zero legs a round was missing once the leg set grew.
+        zero_ab = {
+            name: {"ran": False, "skip_reason": reason} for name, _, _ in zero_legs
+        }
     else:
         try:
             import dataclasses as _dcz
@@ -423,11 +430,14 @@ def main() -> None:
 
             zero_ab = {}
             zsteps = max(steps // 2, 2)
-            for name, stage in (("zero1", 1), ("zero23", 3)):
+            for name, stage, layer in zero_legs:
                 cfg_z = _dcz.replace(
                     config,
                     parallel=_dcz.replace(
-                        config.parallel, shard_weight_update=True, zero_stage=stage
+                        config.parallel,
+                        shard_weight_update=True,
+                        zero_stage=stage,
+                        zero_layer_granular=layer,
                     ),
                 )
                 state_z = create_state(  # mocolint: disable=JX003  (A/B legs share the main run's init seed on purpose: identical weights across zero1/zero23)
@@ -455,19 +465,49 @@ def main() -> None:
                 mem = device_memory_stats() or {}
                 ledger = _comms.payload()
                 zero_ab[name] = {
+                    "ran": True,
                     "imgs_per_sec_per_chip": round(batch * zsteps / dtz / n_dev, 2),
                     "hbm_peak_bytes": mem.get("hbm_peak_bytes"),
                     "hbm_state_bytes_per_chip": tree_shard_bytes(st),
+                    # analytic shards + live-gather transient: the PEAK
+                    # model bytes (not just at-rest) — the number the
+                    # layer-granular stage actually moves, trackable on
+                    # CPU-smoke rounds where device memory_stats is null
+                    "hbm_model_peak_bytes_analytic": getattr(
+                        step_z, "hbm_model_peak_bytes", None
+                    ),
                     "comms_bytes_per_step": ledger.get("comms/total", 0),
                 }
+                # Max-feasible-batch probe (analytic, not an OOM search):
+                # capacity left after the leg's peak model bytes + state,
+                # divided by the measured per-image activation footprint.
+                # Null on hosts without memory_stats; the device peak is
+                # a process-lifetime watermark, so treat it as a floor
+                # estimate, not a guarantee.
+                probe = None
+                live = mem.get("hbm_live_bytes")
+                headroom = mem.get("hbm_headroom_bytes")
+                peak_dev = mem.get("hbm_peak_bytes")
+                model_peak = zero_ab[name]["hbm_model_peak_bytes_analytic"]
+                state_b = zero_ab[name]["hbm_state_bytes_per_chip"]
+                if None not in (live, headroom, peak_dev, model_peak):
+                    limit = headroom + live
+                    act_per_img = max(peak_dev - model_peak - state_b, 1) / batch
+                    probe = int(max(limit - model_peak - state_b, 0) // act_per_img)
+                zero_ab[name]["max_feasible_batch_probe"] = probe
             legs["zero_ab"]["ran"] = True
             saved = (
                 zero_ab["zero1"]["hbm_state_bytes_per_chip"]
                 - zero_ab["zero23"]["hbm_state_bytes_per_chip"]
             )
+            peak23 = zero_ab["zero23"]["hbm_model_peak_bytes_analytic"]
+            peakl = zero_ab["zero_layer"]["hbm_model_peak_bytes_analytic"]
+            ratio = round(peak23 / peakl, 2) if peak23 and peakl else None
             print(
                 f"zero A/B: zero1={zero_ab['zero1']} zero23={zero_ab['zero23']} "
-                f"(at-rest state saved/chip: {saved / 1e6:.1f} MB)",
+                f"zero_layer={zero_ab['zero_layer']} "
+                f"(at-rest state saved/chip: {saved / 1e6:.1f} MB, "
+                f"layer-granular peak-model ratio: {ratio})",
                 file=sys.stderr,
             )
         except Exception as e:

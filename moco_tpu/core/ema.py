@@ -22,3 +22,17 @@ import jax
 def ema_update(params_k, params_q, momentum: float):
     """params_k <- params_k * m + params_q * (1 - m), elementwise over the tree."""
     return jax.tree.map(lambda k, q: k * momentum + q * (1.0 - momentum), params_k, params_q)
+
+
+def momentum_bn_stats(running, batch, momentum: float):
+    """Momentum-statistics BN update ("Momentum² Teacher",
+    arXiv:2101.07525 §3.2): the NEW running statistic
+    `m * running + (1 - m) * batch`, which the layer both normalizes
+    with and stores — the large-batch alternative to cross-replica BN
+    statistics. Same elementwise EMA as `ema_update`, exposed per tree
+    OR per leaf for harness/report use; the in-model implementation
+    lives inline in `models/resnet.py` (models/ must not import core/,
+    see `moco_tpu/core/__init__.py`'s import order)."""
+    if isinstance(running, (list, dict, tuple)):
+        return ema_update(running, batch, momentum)
+    return running * momentum + batch * (1.0 - momentum)

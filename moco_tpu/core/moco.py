@@ -53,7 +53,9 @@ from moco_tpu.parallel.shuffle import (
 )
 from moco_tpu.parallel.zero import (
     BucketPlan,
+    GroupPlan,
     expand_opt_state,
+    padded_cols,
     shard_template,
     shard_tree,
     sharded_update,
@@ -64,13 +66,23 @@ from moco_tpu.utils.config import MocoConfig, TrainConfig
 
 class MoCoEncoder(nn.Module):
     """backbone + projection head = the reference's `base_encoder(num_classes=dim)`
-    with optional MLP surgery (`moco/builder.py:~L20-30`), composed explicitly."""
+    with optional MLP surgery (`moco/builder.py:~L20-30`), composed explicitly.
+
+    `group`: layer-granular apply (the ZeRO-3 per-group schedule) — run
+    only the named backbone group ("stem"/"blockN"/"embed"/...) or the
+    "head" group on `x`, which is then the PREVIOUS group's activation,
+    not an image. `group=None` is the classic whole-encoder forward;
+    both paths register identical parameter trees."""
 
     backbone: nn.Module
     head: nn.Module
 
-    def __call__(self, x, train: bool = True):
-        return self.head(self.backbone(x, train=train), train=train)
+    def __call__(self, x, train: bool = True, group: Optional[str] = None):
+        if group is None:
+            return self.head(self.backbone(x, train=train), train=train)
+        if group == "head":
+            return self.head(x, train=train)
+        return self.backbone(x, train=train, group=group)
 
 
 def create_backbone(cfg: MocoConfig, num_data: Optional[int] = None) -> nn.Module:
@@ -83,11 +95,12 @@ def create_backbone(cfg: MocoConfig, num_data: Optional[int] = None) -> nn.Modul
         # a ResNet would double backbone grads over the model axis
         raise ValueError(f"vit_sequence_parallel requires a ViT arch, got {cfg.arch!r}")
     if cfg.arch.startswith("vit"):
-        if cfg.bn_stats_rows or cfg.bn_virtual_groups > 1:
+        if cfg.bn_stats_rows or cfg.bn_virtual_groups > 1 or cfg.bn_momentum_stats:
             # must fail loudly: a ViT has no BatchNorm, the lever would be
             # inert while the checkpoint config records it as active
             raise ValueError(
-                "bn_stats_rows / bn_virtual_groups apply to ResNet BatchNorm, not ViT archs"
+                "bn_stats_rows / bn_virtual_groups / bn_momentum_stats apply "
+                "to ResNet BatchNorm, not ViT archs"
             )
         from moco_tpu.models.vit import create_vit
 
@@ -180,6 +193,7 @@ def create_backbone(cfg: MocoConfig, num_data: Optional[int] = None) -> nn.Modul
         bn_stats_rows=cfg.bn_stats_rows,
         bn_stats_barrier=cfg.bn_stats_barrier,
         bn_virtual_groups=cfg.bn_virtual_groups,
+        bn_momentum_stats=cfg.bn_momentum_stats,
     )
 
 
@@ -254,6 +268,40 @@ def zero_stage23(config: TrainConfig) -> bool:
     """Whether the config selects the persistently-sharded-params ZeRO
     stage (2 and 3 both map to the one implementation)."""
     return config.parallel.shard_weight_update and config.parallel.zero_stage >= 2
+
+
+def zero_layer_granular(config: TrainConfig) -> bool:
+    """Whether the config selects the LAYER-GRANULAR stage-2/3 schedule:
+    per-group just-in-time gather/free instead of the whole-tree gather."""
+    return zero_stage23(config) and config.parallel.zero_layer_granular
+
+
+def _overlay(orig, upd):
+    """Merge a PARTIAL mutated batch_stats tree (from a layer-group
+    apply, which only touches the called group's entries) back over the
+    full tree, preserving `orig`'s nesting — entries the group never
+    visited pass through unchanged."""
+    if not hasattr(orig, "items"):
+        return upd
+    return {k: (_overlay(v, upd[k]) if k in upd else v) for k, v in orig.items()}
+
+
+def _tree_full_bytes(tree) -> int:
+    """Bytes of a shape/dtype-carrying abstract tree's FULL leaves."""
+    return sum(
+        (int(np.prod(tuple(l.shape))) if l.shape else 1) * jnp.dtype(l.dtype).itemsize
+        for l in jax.tree.leaves(tree)
+    )
+
+
+def _tree_shard_bytes_analytic(tree, n: int) -> int:
+    """Per-chip bytes of the same tree in the persistent (n, m) layout
+    (each replica's row, padding included)."""
+    return sum(
+        padded_cols(int(np.prod(tuple(l.shape))) if l.shape else 1, n)
+        * jnp.dtype(l.dtype).itemsize
+        for l in jax.tree.leaves(tree)
+    )
 
 
 def full_param_shapes(config: TrainConfig, encoder: MoCoEncoder, predictor=None) -> dict:
@@ -464,6 +512,13 @@ def make_train_step(
     # reconstruction of full leaves) derive from an abstract init
     plan_trainable = plan_enc = None
     trainable_shapes = None
+    zero_layer = zero_layer_granular(config)
+    if config.parallel.zero_layer_granular and not zero23:
+        raise ValueError(
+            "zero_layer_granular requires shard_weight_update=True with "
+            "zero_stage >= 2 (the per-group schedule runs on the persistent "
+            "shard layout)"
+        )
     if zero23:
         trainable_shapes = full_param_shapes(config, encoder, predictor)
         bucket_bytes = int(config.parallel.zero_bucket_mb * 1024 * 1024)
@@ -475,6 +530,204 @@ def make_train_step(
         )
         _trainable_def = jax.tree.structure(trainable_shapes)
         _enc_def = jax.tree.structure(trainable_shapes["enc"])
+    # ---- layer-granular stage 2/3 static machinery ---------------------
+    # GroupPlan over the encoder leaves (backbone groups in schedule
+    # order, then the projection head) + a separate single-group plan
+    # for the predictor; the analytic HBM peak for BOTH schedules so
+    # bench/harness legs can A/B without device memory_stats.
+    enc_group_plan = None
+    pred_bucket_plan = None
+    g_names: tuple = ()
+    hbm_model_peak_bytes = None
+    if zero23:
+        _shard_resident = _tree_shard_bytes_analytic(
+            trainable_shapes, n_data
+        ) + _tree_shard_bytes_analytic(trainable_shapes["enc"], n_data)
+        hbm_model_peak_bytes = (
+            _shard_resident
+            + _tree_full_bytes(trainable_shapes)
+            + _tree_full_bytes(trainable_shapes["enc"])
+        )
+    if zero_layer:
+        if n_model > 1:
+            raise ValueError(
+                "zero_layer_granular requires num_model == 1 (the per-group "
+                "schedule is a data-axis pipeline; model-axis sharding of the "
+                "same params would double-gather)"
+            )
+        if cfg.vit_sequence_parallel:
+            raise ValueError(
+                "zero_layer_granular does not compose with vit_sequence_parallel "
+                "(the token shard would cross layer-group boundaries)"
+            )
+        _enc_leaves = jax.tree.leaves(trainable_shapes["enc"])
+        _index_tree = jax.tree.unflatten(_enc_def, list(range(len(_enc_leaves))))
+        _bb_childmap = encoder.backbone.group_param_names()
+        _group_specs = []
+        for _g in encoder.backbone.group_names:
+            _idx: list = []
+            for _child in _bb_childmap[_g]:
+                _idx.extend(jax.tree.leaves(_index_tree["backbone"][_child]))
+            _group_specs.append((_g, tuple(_idx)))
+        _group_specs.append(("head", tuple(jax.tree.leaves(_index_tree["head"]))))
+        # GroupPlan raises if the backbone's group map misses any leaf —
+        # a silently-ungathered param would train as garbage
+        enc_group_plan = GroupPlan(_enc_leaves, _group_specs, n_data, bucket_bytes)
+        g_names = tuple(g.name for g in enc_group_plan.groups)
+        _pred_def = jax.tree.structure(trainable_shapes["pred"])
+        _pred_bytes = _tree_full_bytes(trainable_shapes["pred"])
+        if jax.tree.leaves(trainable_shapes["pred"]):
+            pred_bucket_plan = BucketPlan(
+                jax.tree.leaves(trainable_shapes["pred"]), n_data, bucket_bytes
+            )
+        # transient high-water mark of the one-group-ahead schedule: the
+        # largest adjacent pair along (enc groups..., predictor)
+        _sizes = [g.full_bytes for g in enc_group_plan.groups]
+        if _pred_bytes:
+            _sizes.append(_pred_bytes)
+        _transient = (
+            _sizes[0]
+            if len(_sizes) == 1
+            else max(a + b for a, b in zip(_sizes, _sizes[1:]))
+        )
+        hbm_model_peak_bytes = _shard_resident + _transient
+
+        def _partial_enc(gname: str, full_leaves):
+            """Rebuild the PARTIAL {"backbone"/"head": ...} params tree
+            holding only group `gname`'s full leaves (group leaf order
+            == the order `_group_specs` enumerated them). Flax never
+            reads an uncalled module's params, so the grouped apply
+            accepts the partial tree as-is."""
+            it = iter(full_leaves)
+            if gname == "head":
+                d = jax.tree.structure(trainable_shapes["enc"]["head"])
+                return {
+                    "head": jax.tree.unflatten(
+                        d, [next(it) for _ in range(d.num_leaves)]
+                    )
+                }
+            out = {}
+            for _child in _bb_childmap[gname]:
+                d = jax.tree.structure(trainable_shapes["enc"]["backbone"][_child])
+                out[_child] = jax.tree.unflatten(
+                    d, [next(it) for _ in range(d.num_leaves)]
+                )
+            return {"backbone": out}
+
+        from moco_tpu.parallel.compat import optimization_barrier
+
+        def _tie(leaves_list, anchor):
+            """One-group-ahead liveness bound: barrier-tie the NEXT
+            group's gather inputs to the CURRENT group's input
+            activation, so XLA may overlap that gather with the current
+            group's compute but cannot hoist it any earlier — at most
+            two adjacent groups' full params are ever live."""
+            tied = optimization_barrier((tuple(leaves_list), anchor))
+            return list(tied[0])
+
+        def layer_key_forward(params_k0, shards_k, stats, x, train=True):
+            """Grouped key forward (no grad): group 0's full params
+            arrive pre-gathered from the prefetch program; each next
+            group's gather is issued under the current group's compute
+            (`_tie`). Returns (features, merged batch_stats)."""
+            k_leaves = jax.tree.leaves(shards_k)
+            cur_params = params_k0
+            for gi, gname in enumerate(g_names):
+                if gi + 1 < len(g_names):
+                    nxt = enc_group_plan.group_shards(k_leaves, gi + 1)
+                    nxt = _tie(nxt, x)
+                    nxt_full = enc_group_plan.gather_group(
+                        nxt, gi + 1, site_prefix="zero.gather.k"
+                    )
+                x, mut = encoder.apply(
+                    {"params": cur_params, "batch_stats": stats},
+                    x,
+                    train=train,
+                    mutable=["batch_stats"],
+                    group=gname,
+                )
+                stats = _overlay(stats, mut.get("batch_stats", {}))
+                if gi + 1 < len(g_names):
+                    cur_params = _partial_enc(g_names[gi + 1], nxt_full)
+            return x, stats
+
+        def _make_q_segment(gi: int, gname: str):
+            """One rematerialized query segment: gather the group's full
+            params + run the group. `jax.checkpoint` drops the full
+            params (and activations) after the forward and re-gathers in
+            the backward — true ZeRO-3: backward too only ever holds one
+            group's full params, at one extra gather of comms.
+
+            Numerics: the LOSS trajectory is bitwise identical to the
+            whole-tree stage (remat recomputes the same forward values),
+            and on ResNet the gradients are too. On ViT, `jax.checkpoint`
+            alone — no sharding, single device — shifts backward
+            gradients by ~1e-9 ULPs on CPU (XLA fuses the rematerialized
+            backward differently around layernorm/attention reductions),
+            so ViT params track the baseline to ~1e-5 rather than
+            bitwise; tests assert accordingly."""
+
+            def seg(group_shards, x, stats):
+                full = enc_group_plan.gather_group(
+                    list(group_shards), gi, site_prefix="zero.gather.q"
+                )
+                out, mut = encoder.apply(
+                    {"params": _partial_enc(gname, full), "batch_stats": stats},
+                    x,
+                    train=True,
+                    mutable=["batch_stats"],
+                    group=gname,
+                )
+                return out, mut.get("batch_stats", {})
+
+            return jax.checkpoint(seg)
+
+        _q_segments = [_make_q_segment(gi, g) for gi, g in enumerate(g_names)]
+
+        def layer_query_forward(enc_sh, stats_q, x):
+            """Grouped query forward over the SHARD tree. Each group's
+            gather is tied one group ahead (to the previous segment's
+            input), same liveness bound as the key side. Gradients flow
+            through the in-segment gathers: their AD transpose is the
+            bucketed psum_scatter, landing SUMMED cotangents directly on
+            the (m,) shards. Stats thread SEQUENTIALLY through the
+            segments (like the key side): flax returns the FULL mutated
+            collection from a grouped apply, so feeding each segment the
+            original stats would let later groups' returns clobber
+            earlier groups' fresh running-stat updates in the overlay —
+            and momentum-statistics BN reads the running values
+            in-forward, so sequential threading is also the semantics
+            that matches the whole-tree apply."""
+            leaves = jax.tree.leaves(enc_sh)
+            stats = stats_q
+            prev_in = None
+            for gi, seg in enumerate(_q_segments):
+                gs = enc_group_plan.group_shards(leaves, gi)
+                if prev_in is not None:
+                    gs = _tie(gs, prev_in)
+                cur_in = x
+                x, mut = seg(tuple(gs), x, stats)
+                stats = _overlay(stats, mut)
+                prev_in = cur_in
+            return x, stats
+
+        def layer_pred_forward(pred_sh, stats_pred, feats):
+            """Predictor segment (v3): one more group on the query
+            schedule, same gather-inside-remat structure."""
+            leaves = tuple(jax.tree.leaves(pred_sh))
+
+            def seg(lvs, feats, stats):
+                full = pred_bucket_plan.gather(list(lvs), site="zero.gather.q.pred")
+                params = jax.tree.unflatten(_pred_def, full)
+                out, mut = predictor.apply(
+                    {"params": params, "batch_stats": stats},
+                    feats,
+                    train=True,
+                    mutable=["batch_stats"],
+                )
+                return out, mut.get("batch_stats", {})
+
+            return jax.checkpoint(seg)(leaves, feats, stats_pred)
     # Fused streaming InfoNCE (pallas): auto-on for a TPU backend with a
     # replicated, tile-divisible queue; explicit True forces it (interpret
     # mode off-TPU), False forces the dense logits path.
@@ -552,6 +805,25 @@ def make_train_step(
         new_tr_sh = jax.tree.map(lambda p, u: p + u, trainable_sh, updates)
         return trainable_sh, new_tr_sh, expand_opt_state(new_opt)
 
+    def zero_layer_update(state: MocoState, grad_sh):
+        """Layer-granular weight update: the in-segment gathers' AD
+        transposes already psum_scatter'd the grads onto the (m,)
+        shards as cross-replica SUMS — divide by n for the mean
+        (element→row assignment and ring order match `scatter_mean`,
+        so the result is bit-identical to `zero23_update`'s), then the
+        elementwise optimizer on this replica's rows. Same return
+        contract as `zero23_update`."""
+        grad_sh = jax.tree.map(lambda g: g / n_data, grad_sh)
+        trainable_sh = {
+            "enc": squeeze_opt_state(state.params_q),
+            "pred": squeeze_opt_state(state.params_pred),
+        }
+        updates, new_opt = tx.update(
+            grad_sh, squeeze_opt_state(state.opt_state), trainable_sh
+        )
+        new_tr_sh = jax.tree.map(lambda p, u: p + u, trainable_sh, updates)
+        return trainable_sh, new_tr_sh, expand_opt_state(new_opt)
+
     def gather_core(state: MocoState) -> ZeroGathered:
         """ZeRO-2/3 step-start stage, hoisted into the pipelined driver
         so it hides under the previous step's compute: the EMA key
@@ -580,6 +852,26 @@ def make_train_step(
             shards_k=expand_opt_state(k_sh),
         )
 
+    def gather_core_layer(state: MocoState) -> ZeroGathered:
+        """Layer-granular prefetch program: same shard-local EMA as
+        `gather_core`, but gather ONLY key group 0 — the step's in-loop
+        pipeline gathers each next key group under the previous group's
+        compute, and the query side re-gathers inside its rematerialized
+        segments, so nothing else pre-materializes. `trainable` is empty:
+        the layer step differentiates over the shards directly."""
+        m = ema_momentum(state.step)
+        enc_sh = squeeze_opt_state(state.params_q)
+        k_sh = ema_update(squeeze_opt_state(state.params_k), enc_sh, m)
+        k_leaves = jax.tree.leaves(k_sh)
+        g0_full = enc_group_plan.gather_group(
+            enc_group_plan.group_shards(k_leaves, 0), 0, site_prefix="zero.gather.k"
+        )
+        return ZeroGathered(
+            trainable={},
+            params_k=_partial_enc(g_names[0], g0_full),
+            shards_k=expand_opt_state(k_sh),
+        )
+
     def v3_step(state: MocoState, batch, gathered: Optional[ZeroGathered] = None):
         """MoCo v3 (arXiv:2104.02057 alg. 1): symmetric queue-free
         contrastive loss, both views through both encoders, the global
@@ -590,13 +882,24 @@ def make_train_step(
         local_b = im_q.shape[0]
         x_cat = jnp.concatenate([im_q, im_k], axis=0)
 
-        if gathered is None:
-            params_k = ema_update(
-                state.params_k, state.params_q, ema_momentum(state.step)
+        if zero_layer:
+            # grouped key forward over the freshly-EMA'd shards; group 0
+            # arrives pre-gathered from the prefetch program
+            params_k = None
+            k_cat, stats_k = layer_key_forward(
+                gathered.params_k,
+                squeeze_opt_state(gathered.shards_k),
+                state.batch_stats_k,
+                x_cat,
             )
         else:
-            params_k = gathered.params_k
-        k_cat, stats_k = apply_encoder(params_k, state.batch_stats_k, x_cat)
+            if gathered is None:
+                params_k = ema_update(
+                    state.params_k, state.params_q, ema_momentum(state.step)
+                )
+            else:
+                params_k = gathered.params_k
+            k_cat, stats_k = apply_encoder(params_k, state.batch_stats_k, x_cat)
         k1, k2 = jnp.split(lax.stop_gradient(l2_normalize(k_cat)), 2, axis=0)
         if n_data > 1:
             with comms.tag("v3.key_gather", "all_gather", (k1, k2), n_data):
@@ -612,20 +915,38 @@ def make_train_step(
             return 2.0 * cfg.temperature * cross_entropy(logits, labels), logits
 
         def loss_fn(trainable):
-            feats, stats_q = grad_apply_encoder(trainable["enc"], state.batch_stats_q, x_cat)
-            preds, stats_pred = apply_predictor(
-                trainable["pred"], state.batch_stats_pred, feats
-            )
+            if zero_layer:
+                # layer-granular: `trainable` is the SHARD tree; each
+                # segment gathers its group's full params just-in-time
+                feats, stats_q = layer_query_forward(
+                    trainable["enc"], state.batch_stats_q, x_cat
+                )
+                preds, stats_pred = layer_pred_forward(
+                    trainable["pred"], state.batch_stats_pred, feats
+                )
+            else:
+                feats, stats_q = grad_apply_encoder(
+                    trainable["enc"], state.batch_stats_q, x_cat
+                )
+                preds, stats_pred = apply_predictor(
+                    trainable["pred"], state.batch_stats_pred, feats
+                )
             q1, q2 = jnp.split(l2_normalize(preds), 2, axis=0)
             loss1, logits = ctr(q1, k2_g)
             loss2, _ = ctr(q2, k1_g)
             return loss1 + loss2, (stats_q, stats_pred, logits, q1)
 
-        trainable = (
-            {"enc": state.params_q, "pred": state.params_pred}
-            if gathered is None
-            else gathered.trainable
-        )
+        if zero_layer:
+            trainable = {
+                "enc": squeeze_opt_state(state.params_q),
+                "pred": squeeze_opt_state(state.params_pred),
+            }
+        else:
+            trainable = (
+                {"enc": state.params_q, "pred": state.params_pred}
+                if gathered is None
+                else gathered.trainable
+            )
         (loss, (stats_q, stats_pred, logits, q1)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(trainable)
@@ -654,7 +975,12 @@ def make_train_step(
         if gathered is not None:
             # ZeRO-2/3: bucketed psum_scatter + shard-local update; the
             # params never re-materialize — the next step's gather does.
-            trainable_sh, new_tr_sh, opt_state = zero23_update(state, grads)
+            # In layer mode the scatter already ran inside the segments'
+            # backward, so `grads` arrived as summed (m,) shards.
+            if zero_layer:
+                trainable_sh, new_tr_sh, opt_state = zero_layer_update(state, grads)
+            else:
+                trainable_sh, new_tr_sh, opt_state = zero23_update(state, grads)
             if cfg.freeze_patch_embed and "patch_embed" in new_tr_sh["enc"].get(
                 "backbone", {}
             ):
@@ -746,12 +1072,24 @@ def make_train_step(
         # forward, as upstream orders it (moco/builder.py:~L139-141).
         # At ZeRO stage 2/3 both encoders live as shards and the EMA
         # already ran shard-local inside the gather stage.
-        if gathered is None:
-            params_k = ema_update(
-                state.params_k, state.params_q, ema_momentum(state.step)
+        if zero_layer:
+            # grouped key forward (one-group-ahead pipeline); group 0
+            # arrives pre-gathered from the prefetch program
+            params_k = None
+            _k_shards = squeeze_opt_state(gathered.shards_k)
+            key_apply = lambda stats, x, train=True: layer_key_forward(
+                gathered.params_k, _k_shards, stats, x, train=train
             )
         else:
-            params_k = gathered.params_k
+            if gathered is None:
+                params_k = ema_update(
+                    state.params_k, state.params_q, ema_momentum(state.step)
+                )
+            else:
+                params_k = gathered.params_k
+            key_apply = lambda stats, x, train=True: apply_encoder(
+                params_k, stats, x, train=train
+            )
 
         # (2) Shuffle-BN: compute keys on a batch that contains none of
         # this device's own positives. With bn_virtual_groups the same
@@ -764,12 +1102,12 @@ def make_train_step(
         if cfg.shuffle == "gather_perm" and shuffle_active:
             perm, inv_perm = make_permutation(step_rng, global_batch)
             im_k_sh = shuffle_gather(im_k, perm, DATA_AXIS)
-            k_sh, stats_k = apply_encoder(params_k, state.batch_stats_k, im_k_sh)
+            k_sh, stats_k = key_apply(state.batch_stats_k, im_k_sh)
             k_sh = l2_normalize(k_sh)
             k_local, k_global = unshuffle_gather(k_sh, inv_perm, DATA_AXIS)
         elif cfg.shuffle == "a2a" and shuffle_active:
             im_k_sh = balanced_shuffle(step_rng, im_k, DATA_AXIS)
-            k_sh, stats_k = apply_encoder(params_k, state.batch_stats_k, im_k_sh)
+            k_sh, stats_k = key_apply(state.batch_stats_k, im_k_sh)
             k_sh = l2_normalize(k_sh)
             # the unshuffle must regenerate the SAME permutation as the
             # shuffle above, so reusing step_rng is the contract, not a bug
@@ -782,9 +1120,8 @@ def make_train_step(
             # no statistics pass, no composition leak, no shuffle
             # collectives; the returned stats tree is unchanged and is
             # replaced by the EMA advance in (4) below.
-            k_local, stats_k = apply_encoder(
-                params_k, state.batch_stats_k, im_k,
-                train=not cfg.key_bn_running_stats,
+            k_local, stats_k = key_apply(
+                state.batch_stats_k, im_k, train=not cfg.key_bn_running_stats
             )
             k_local = l2_normalize(k_local)
             if n_data > 1:
@@ -797,7 +1134,14 @@ def make_train_step(
 
         # (3) Query forward + InfoNCE loss (moco/builder.py:~L128-161).
         def loss_fn(trainable):
-            q, stats_q = grad_apply_encoder(trainable["enc"], state.batch_stats_q, im_q)
+            if zero_layer:
+                q, stats_q = layer_query_forward(
+                    trainable["enc"], state.batch_stats_q, im_q
+                )
+            else:
+                q, stats_q = grad_apply_encoder(
+                    trainable["enc"], state.batch_stats_q, im_q
+                )
             q = l2_normalize(q)
             if cfg.num_negatives and use_fused:
                 # streaming pallas kernel: never materializes (B, 1+K)
@@ -831,11 +1175,17 @@ def make_train_step(
                 acc = topk_accuracy(logits, labels)
             return loss, (stats_q, acc, q)
 
-        trainable = (
-            {"enc": state.params_q, "pred": state.params_pred}
-            if gathered is None
-            else gathered.trainable
-        )
+        if zero_layer:
+            trainable = {
+                "enc": squeeze_opt_state(state.params_q),
+                "pred": squeeze_opt_state(state.params_pred),
+            }
+        else:
+            trainable = (
+                {"enc": state.params_q, "pred": state.params_pred}
+                if gathered is None
+                else gathered.trainable
+            )
         (loss, (stats_q, acc, q_feats)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             trainable
         )
@@ -876,7 +1226,10 @@ def make_train_step(
         if gathered is not None:
             if shard_queue_over_model:
                 grads = lax.pmean(grads, MODEL_AXIS)
-            _, new_tr_sh, opt_state = zero23_update(state, grads)
+            if zero_layer:
+                _, new_tr_sh, opt_state = zero_layer_update(state, grads)
+            else:
+                _, new_tr_sh, opt_state = zero23_update(state, grads)
             drift = lambda: obs_health.ema_drift_sharded(
                 new_tr_sh["enc"], squeeze_opt_state(gathered.shards_k), DATA_AXIS
             )
@@ -1000,7 +1353,7 @@ def make_train_step(
         trainable=P(), params_k=P(), shards_k=P(DATA_AXIS, None)
     )
     gather_sharded = shard_map(
-        gather_core,
+        gather_core_layer if zero_layer else gather_core,
         mesh=mesh,
         in_specs=(specs,),
         out_specs=gathered_specs,
@@ -1041,6 +1394,9 @@ def make_train_step(
         step=jax.jit(step_sharded, **step_kwargs),
         param_shapes=trainable_shapes,
         bucket_plans={"trainable": plan_trainable, "enc": plan_enc},
+        group_plan=enc_group_plan,
+        layer_granular=zero_layer,
+        hbm_model_peak_bytes=hbm_model_peak_bytes,
     )
 
 
@@ -1088,13 +1444,34 @@ class Zero23TrainStep:
     Calling the object runs both inline — the un-hoisted schedule —
     so non-pipelined callers (tests, bench legs) keep the single-callable
     contract of the classic step.
+
+    `layer_granular` marks the per-group schedule
+    (`parallel.zero_layer_granular`): `gather` is then the group-0
+    prefetch program and `group_plan` the encoder's `GroupPlan`.
+    `hbm_model_peak_bytes` is the ANALYTIC per-chip model-memory
+    high-water mark (persistent shards + the schedule's transient full
+    params: whole trainable + key tree for the classic gather, the
+    largest adjacent group pair for the layer schedule) — the gauge the
+    CPU-smoke bench legs track where `device_memory_stats` is None.
     """
 
-    def __init__(self, gather, step, param_shapes, bucket_plans):
+    def __init__(
+        self,
+        gather,
+        step,
+        param_shapes,
+        bucket_plans,
+        group_plan=None,
+        layer_granular: bool = False,
+        hbm_model_peak_bytes: Optional[int] = None,
+    ):
         self.gather = gather
         self.step = step
         self.param_shapes = param_shapes  # {"enc": ..., "pred": ...} abstract
         self.bucket_plans = bucket_plans
+        self.group_plan = group_plan
+        self.layer_granular = layer_granular
+        self.hbm_model_peak_bytes = hbm_model_peak_bytes
 
     def __call__(self, state, batch, root_rng):
         return self.step(state, self.gather(state), batch, root_rng)

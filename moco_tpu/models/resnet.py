@@ -61,6 +61,19 @@ class BatchNorm(nn.Module):
     `axis_name` composes subset statistics cross-replica (SyncBN); it is
     rejected with virtual_groups (subgrouped SyncBN already covers the
     cross-device grouping pattern).
+
+    A third training mode, `momentum_stats` ("Momentum² Teacher",
+    arXiv:2101.07525 §3.2): normalize with the momentum-UPDATED running
+    statistics — `m_new = momentum * running + (1 - momentum) * batch`,
+    normalize with `m_new`, store `m_new` — instead of the raw batch
+    statistics. Normalization decouples from the per-batch sample (the
+    huge-batch alternative to cross-replica statistics: statistics
+    precision comes from history, not from syncing one big batch), and
+    gradients still flow through the `(1 - momentum) * batch` term.
+    Eval mode is unchanged (running average), so checkpoints stay
+    interchangeable. Mutually exclusive with stats_rows/virtual_groups;
+    composes with `axis_name` (the batch term is then the cross-replica
+    mean, i.e. momentum SyncBN).
     """
 
     stats_rows: int = 0
@@ -72,6 +85,8 @@ class BatchNorm(nn.Module):
     # small (r rows) materialization per BN.
     stats_barrier: bool = False
     virtual_groups: int = 0
+    # Momentum-statistics mode (Momentum² Teacher): see class docstring.
+    momentum_stats: bool = False
     use_running_average: bool = False
     momentum: float = 0.9
     epsilon: float = 1e-5
@@ -105,6 +120,10 @@ class BatchNorm(nn.Module):
             raise ValueError("stats_barrier requires stats_rows > 0")
         if self.virtual_groups > 1 and self.axis_name is not None:
             raise ValueError("virtual_groups does not compose with cross-replica BN")
+        if self.momentum_stats and (self.stats_rows or self.virtual_groups > 1):
+            raise ValueError(
+                "momentum_stats is mutually exclusive with stats_rows/virtual_groups"
+            )
         if self.use_running_average:
             mean, var = ra_mean.value, ra_var.value
         elif self.virtual_groups > 1:
@@ -154,7 +173,18 @@ class BatchNorm(nn.Module):
                     axis_index_groups=self.axis_index_groups,
                 )
             var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
-            if not self.is_initializing():
+            if self.momentum_stats:
+                # Momentum² Teacher: normalize with the momentum-updated
+                # running statistics (same math as core/ema.py's
+                # momentum_bn_stats — inlined, models/ must not import
+                # core/). The batch term keeps the statistics gradient
+                # path alive at (1 - momentum) weight.
+                mean = self.momentum * ra_mean.value + (1 - self.momentum) * mean
+                var = self.momentum * ra_var.value + (1 - self.momentum) * var
+                if not self.is_initializing():
+                    ra_mean.value = mean
+                    ra_var.value = var
+            elif not self.is_initializing():
                 ra_mean.value = self.momentum * ra_mean.value + (1 - self.momentum) * mean
                 ra_var.value = self.momentum * ra_var.value + (1 - self.momentum) * var
         mul = scale * jax.lax.rsqrt(var + self.epsilon)
@@ -252,20 +282,44 @@ class ResNet(nn.Module):
     # Per-group statistics over G contiguous row-groups (the reference's
     # per-GPU BN inside one device's batch). See BatchNorm above.
     bn_virtual_groups: int = 0
+    # Momentum-statistics BN (Momentum² Teacher) — see BatchNorm above.
+    bn_momentum_stats: bool = False
 
     @property
     def num_features(self) -> int:
         return self.num_filters * (2 ** (len(self.stage_sizes) - 1)) * self.block.expansion
 
+    @property
+    def group_names(self) -> tuple:
+        """Schedule-ordered layer groups for the layer-granular ZeRO-3
+        apply: the stem, then one group per residual block."""
+        return ("stem",) + tuple(f"block{k}" for k in range(sum(self.stage_sizes)))
+
+    def group_param_names(self) -> dict:
+        """group -> its top-level param-tree child names. The names are
+        flax AUTO-names, so they are pinned by construction order — the
+        grouped `__call__` below constructs every submodule in canonical
+        order precisely so this map stays true."""
+        names = {
+            "stem": ("ConvBN_0",) if self.cifar_stem else ("Conv_0", "BatchNorm_0")
+        }
+        blk = self.block.__name__
+        for k in range(sum(self.stage_sizes)):
+            names[f"block{k}"] = (f"{blk}_{k}",)
+        return names
+
     @nn.compact
-    def __call__(self, x, train: bool = True):
-        custom = self.bn_stats_rows or self.bn_virtual_groups > 1
+    def __call__(self, x, train: bool = True, group: Optional[str] = None):
+        custom = (
+            self.bn_stats_rows or self.bn_virtual_groups > 1 or self.bn_momentum_stats
+        )
         norm_cls = BatchNorm if custom else nn.BatchNorm
         extra = (
             {
                 "stats_rows": self.bn_stats_rows,
                 "stats_barrier": self.bn_stats_barrier,
                 "virtual_groups": self.bn_virtual_groups,
+                "momentum_stats": self.bn_momentum_stats,
             }
             if custom
             else {}
@@ -280,29 +334,60 @@ class ResNet(nn.Module):
             axis_index_groups=self.bn_axis_index_groups,
             **extra,
         )
-        x = x.astype(self.dtype)
+        # Construct EVERY submodule, in canonical order, before calling
+        # any: flax assigns auto-names at construction time, so a
+        # group-restricted apply must register the same name sequence as
+        # the full one or the param tree would silently fork.
         if self.cifar_stem:
-            x = ConvBN(self.num_filters, 3, 1, norm)(x)
-            x = nn.relu(x)
+            stem_mods = (ConvBN(self.num_filters, 3, 1, norm),)
         else:
-            x = nn.Conv(
-                self.num_filters,
-                (7, 7),
-                strides=2,
-                padding=[(3, 3), (3, 3)],
-                use_bias=False,
-                kernel_init=conv_kernel_init,
-                dtype=self.dtype,
-            )(x)
-            x = norm()(x)
-            x = nn.relu(x)
-            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+            stem_mods = (
+                nn.Conv(
+                    self.num_filters,
+                    (7, 7),
+                    strides=2,
+                    padding=[(3, 3), (3, 3)],
+                    use_bias=False,
+                    kernel_init=conv_kernel_init,
+                    dtype=self.dtype,
+                ),
+                norm(),
+            )
+        blocks = []
         for i, num_blocks in enumerate(self.stage_sizes):
             for j in range(num_blocks):
                 strides = 2 if i > 0 and j == 0 else 1
-                x = self.block(self.num_filters * 2**i, strides, norm)(x)
-        x = jnp.mean(x, axis=(1, 2))  # global average pool
-        return x.astype(jnp.float32)
+                blocks.append(self.block(self.num_filters * 2**i, strides, norm))
+
+        def run_stem(x):
+            x = x.astype(self.dtype)
+            if self.cifar_stem:
+                return nn.relu(stem_mods[0](x))
+            x = stem_mods[0](x)
+            x = stem_mods[1](x)
+            x = nn.relu(x)
+            return nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+
+        def run_block(k, x):
+            x = blocks[k](x)
+            if k == len(blocks) - 1:
+                x = jnp.mean(x, axis=(1, 2))  # global average pool
+                x = x.astype(jnp.float32)
+            return x
+
+        if group is None:
+            x = run_stem(x)
+            for k in range(len(blocks)):
+                x = run_block(k, x)
+            return x
+        if group == "stem":
+            return run_stem(x)
+        if not (group.startswith("block") and group[5:].isdigit()):
+            raise ValueError(f"unknown layer group {group!r}")
+        k = int(group[5:])
+        if k >= len(blocks):
+            raise ValueError(f"layer group {group!r} out of range ({len(blocks)} blocks)")
+        return run_block(k, x)
 
 
 _CONFIGS = {

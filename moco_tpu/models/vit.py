@@ -159,38 +159,96 @@ class VisionTransformer(nn.Module):
     def num_features(self) -> int:
         return self.hidden_dim
 
+    @property
+    def group_names(self) -> tuple:
+        """Schedule-ordered layer groups for the layer-granular ZeRO-3
+        apply: patch embedding (+cls token), one group per encoder
+        block, and the final norm + pool."""
+        return ("embed",) + tuple(f"block_{i}" for i in range(self.depth)) + ("final",)
+
+    def group_param_names(self) -> dict:
+        """group -> its top-level param-tree child names (all EXPLICIT
+        flax names here, so the map is construction-order independent)."""
+        names = {
+            "embed": ("patch_embed", "cls_token") if self.pool == "cls" else ("patch_embed",),
+            "final": ("final_norm",),
+        }
+        for i in range(self.depth):
+            names[f"block_{i}"] = (f"block_{i}",)
+        return names
+
     @nn.compact
-    def __call__(self, x, train: bool = True):
-        b, h, w, _ = x.shape
-        assert h % self.patch_size == 0 and w % self.patch_size == 0, (
-            f"image {h}x{w} not divisible by patch {self.patch_size}"
-        )
+    def __call__(self, x, train: bool = True, group: Optional[str] = None):
         if self.pool not in ("cls", "gap"):
             raise ValueError(f"pool={self.pool!r}: choose 'cls' or 'gap'")
-        grid = h // self.patch_size
-        x = x.astype(self.dtype)
-        # Patch embedding: conv stride=patch (the "random patch projection"
-        # v3 freezes — freezing is the train step's job, not the module's).
-        x = nn.Conv(
-            self.hidden_dim,
-            (self.patch_size, self.patch_size),
-            strides=self.patch_size,
-            padding="VALID",
-            name="patch_embed",
-            dtype=self.dtype,
-        )(x)
-        x = x.reshape(b, grid * grid, self.hidden_dim)
-        if self.pool == "cls":
-            cls = self.param(
-                "cls_token", nn.initializers.normal(stddev=0.02), (1, 1, self.hidden_dim)
+        if group is not None and self.sequence_axis is not None:
+            raise ValueError(
+                "layer-group apply does not compose with sequence_axis "
+                "(the token shard would cross group boundaries)"
             )
-            x = jnp.concatenate(
-                [jnp.broadcast_to(cls.astype(self.dtype), (b, 1, self.hidden_dim)), x],
-                axis=1,
-            )
-        pos = sincos_2d_posembed(self.hidden_dim, grid, cls_token=self.pool == "cls")
-        x = x + jnp.asarray(pos, self.dtype)
 
+        def run_embed(x):
+            b, h, w, _ = x.shape
+            assert h % self.patch_size == 0 and w % self.patch_size == 0, (
+                f"image {h}x{w} not divisible by patch {self.patch_size}"
+            )
+            grid = h // self.patch_size
+            x = x.astype(self.dtype)
+            # Patch embedding: conv stride=patch (the "random patch
+            # projection" v3 freezes — freezing is the train step's job,
+            # not the module's).
+            x = nn.Conv(
+                self.hidden_dim,
+                (self.patch_size, self.patch_size),
+                strides=self.patch_size,
+                padding="VALID",
+                name="patch_embed",
+                dtype=self.dtype,
+            )(x)
+            x = x.reshape(b, grid * grid, self.hidden_dim)
+            if self.pool == "cls":
+                cls = self.param(
+                    "cls_token", nn.initializers.normal(stddev=0.02), (1, 1, self.hidden_dim)
+                )
+                x = jnp.concatenate(
+                    [jnp.broadcast_to(cls.astype(self.dtype), (b, 1, self.hidden_dim)), x],
+                    axis=1,
+                )
+            pos = sincos_2d_posembed(self.hidden_dim, grid, cls_token=self.pool == "cls")
+            return x + jnp.asarray(pos, self.dtype)
+
+        def make_block(i, attn_fn):
+            return EncoderBlock(
+                num_heads=self.num_heads,
+                mlp_dim=self.mlp_dim,
+                dtype=self.dtype,
+                use_flash_attention=self.use_flash_attention,
+                attention_fn=attn_fn,
+                name=f"block_{i}",
+            )
+
+        def run_final(x, seq_total, sp_rank):
+            x = nn.LayerNorm(dtype=self.dtype, name="final_norm")(x)
+            if self.pool == "cls":
+                return x[:, 0].astype(jnp.float32)
+            # gap: mean over ALL tokens (psum across the shard ring when SP)
+            s = jnp.sum(x.astype(jnp.float32), axis=1)
+            if sp_rank is not None:
+                s = lax.psum(s, self.sequence_axis)
+            return s / seq_total
+
+        if group is not None:
+            if group == "embed":
+                return run_embed(x)
+            if group == "final":
+                return run_final(x, x.shape[1], None)
+            if group.startswith("block_") and group[6:].isdigit():
+                i = int(group[6:])
+                if i < self.depth:
+                    return make_block(i, None)(x)
+            raise ValueError(f"unknown layer group {group!r}")
+
+        x = run_embed(x)
         # Sequence parallelism: bind to the axis if we are inside a
         # shard_map that names it; otherwise (init / single-device eval)
         # run dense. axis_index raises NameError at TRACE time when the
@@ -217,22 +275,8 @@ class VisionTransformer(nn.Module):
             attn_fn = None
 
         for i in range(self.depth):
-            x = EncoderBlock(
-                num_heads=self.num_heads,
-                mlp_dim=self.mlp_dim,
-                dtype=self.dtype,
-                use_flash_attention=self.use_flash_attention,
-                attention_fn=attn_fn,
-                name=f"block_{i}",
-            )(x)
-        x = nn.LayerNorm(dtype=self.dtype, name="final_norm")(x)
-        if self.pool == "cls":
-            return x[:, 0].astype(jnp.float32)
-        # gap: mean over ALL tokens (psum across the shard ring when SP)
-        s = jnp.sum(x.astype(jnp.float32), axis=1)
-        if sp_rank is not None:
-            s = lax.psum(s, self.sequence_axis)
-        return s / seq_total
+            x = make_block(i, attn_fn)(x)
+        return run_final(x, seq_total, sp_rank)
 
 
 _VIT_CONFIGS = {

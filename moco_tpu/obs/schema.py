@@ -154,6 +154,15 @@ FIELD_VALIDATORS = {
     # nothing was absorbed — device-side gather/compute overlap is read
     # from the merged trace's zero_gather spans
     "overlap/zero": _num_or_null,
+    # layer-granular ZeRO-3 (parallel/zero.py GroupPlan): same gauge
+    # under its own key when the per-layer-group gather/free schedule is
+    # active, so dashboards can A/B the two stages from the same run set
+    "overlap/zero_layer": _num_or_null,
+    # analytic per-device PEAK model-param bytes under ZeRO-2/3: shards
+    # + the transient gathered full params (whole tree, or the largest
+    # adjacent group pair when layer-granular) — the memory-claim gauge
+    # that works on CPU meshes where memory_stats is absent
+    "hbm_model_peak_bytes": _num_or_null,
     # MoCo health gauges (obs/health.py)
     "ema_drift": _num_or_null,
     "logit_pos_mean": _num_or_null,
@@ -263,6 +272,11 @@ FIELD_VALIDATORS = {
     "promotion/failed_gate": _str_or_null,
     "promotion/replica": lambda v: v is None or _int_like(v),
     "promotion/step": _int_like,
+    # scaling-law harness verdict lines (scripts/scaling_smoke.py): the
+    # per-leg identity and the battery verdict are strings; every other
+    # scaling/ field rides the numeric prefix family below
+    "scaling/leg": lambda v: isinstance(v, str),
+    "scaling/verdict": lambda v: isinstance(v, str),
     # alert event lines (obs/alerts.py)
     "alert": lambda v: isinstance(v, str),
     "severity": lambda v: v in ("warn", "fatal"),
@@ -281,6 +295,10 @@ PREFIX_VALIDATORS = {
     # elastic rescale event fields (kappa, derived lr/momentum, ...);
     # the explicit entries above (dead_hosts list, int mesh shapes) win
     "rescale/": _num_or_null,
+    # scaling-law battery numerics (kappa, ema-drift ratios, logit gap,
+    # feature_std floor, peak-bytes legs); the explicit string entries
+    # above (scaling/leg, scaling/verdict) win
+    "scaling/": _num_or_null,
     "fleet/": _num_or_null,
     "comms/": _num,
     "alert/": _num,

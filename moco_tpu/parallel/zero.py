@@ -44,6 +44,18 @@ dim, so checkpoints carry exactly each replica's rows and resume is
 topology-stable for the same mesh (and host-side reshard helpers below
 convert between layouts/mesh widths on resume).
 
+**Layer-granular stage 2/3** (`GroupPlan` + the layer schedule in
+core/moco.py, `parallel.zero_layer_granular`): the whole-tree gather
+still materializes every full parameter at once inside the step, so
+peak — not at-rest — memory caps the per-chip batch. The group plan
+partitions the leaves into schedule-ordered layer groups (stem, blocks,
+head), each with its own fusion buckets and its own
+`comms/zero.gather.<group>` ledger site; the step gathers each group
+just-in-time and the rematerialized segment boundaries free it after
+its forward/backward contribution, so the transient cost drops from
+full-tree to at most two adjacent groups (the one-group-ahead
+prefetch).
+
 Element-wise optimizers only (SGD momentum, AdamW): their update is
 position-independent, so updating a flat shard equals sharding the full
 update. LARS is NOT eligible (per-layer trust ratios need whole-tensor
@@ -306,6 +318,126 @@ class BucketPlan:
                 "shard_bytes": b.total_m * b.dtype.itemsize,
             }
             for i, b in enumerate(self.buckets)
+        ]
+
+
+@dataclasses.dataclass(frozen=True)
+class _Group:
+    """One layer group of a GroupPlan: a named, contiguous-in-schedule
+    slice of the tree's leaves with its own fusion-bucket plan."""
+
+    name: str
+    indices: tuple  # leaf positions in jax.tree.leaves order
+    plan: BucketPlan
+    full_bytes: int  # bytes of the group's FULL (unsharded) leaves
+
+
+class GroupPlan:
+    """Layer-granular extension of `BucketPlan`: an ordered partition of
+    a param tree's leaves into named layer groups, each with its own
+    bucket plan, so the step can gather ONE group's full params
+    just-in-time (site `zero.gather.<prefix>_<group>.b<i>`) instead of
+    materializing the whole tree at once.
+
+    The partition must cover every leaf exactly once — a leaf the group
+    map misses would silently never be gathered, so that is a
+    construction-time error, not a runtime surprise. Group order is the
+    schedule order (stem → stages → head); `peak_full_bytes` is the
+    analytic transient high-water mark of the one-group-ahead pipeline:
+    the largest sum of two ADJACENT groups' full bytes (group g's params
+    are still live while group g+1 prefetches).
+    """
+
+    def __init__(
+        self,
+        leaves: Sequence,
+        groups: Sequence,
+        n: int,
+        bucket_bytes: Optional[int] = None,
+    ):
+        """`leaves`: shape/dtype descriptors in `jax.tree.leaves` order;
+        `groups`: ordered `(name, leaf_indices)` pairs partitioning
+        `range(len(leaves))`."""
+        self.n = int(n)
+        leaves = list(leaves)
+        seen: set = set()
+        built = []
+        for name, indices in groups:
+            indices = tuple(int(i) for i in indices)
+            overlap = seen.intersection(indices)
+            if overlap:
+                raise ValueError(
+                    f"group {name!r} re-claims leaves {sorted(overlap)}"
+                )
+            seen.update(indices)
+            full_bytes = 0
+            for i in indices:
+                shape = tuple(leaves[i].shape)
+                size = int(np.prod(shape)) if shape else 1
+                full_bytes += size * jnp.dtype(leaves[i].dtype).itemsize
+            built.append(
+                _Group(
+                    name=str(name),
+                    indices=indices,
+                    plan=BucketPlan([leaves[i] for i in indices], n, bucket_bytes),
+                    full_bytes=full_bytes,
+                )
+            )
+        missing = sorted(set(range(len(leaves))) - seen)
+        if missing:
+            raise ValueError(f"group map misses leaves {missing}")
+        self.groups = tuple(built)
+        self.num_leaves = len(leaves)
+
+    def group_shards(self, shard_leaves: Sequence, gi: int) -> list:
+        """The (m,)/(n, m) shard leaves belonging to group `gi`, in the
+        group's own leaf order (what `gather_group` consumes)."""
+        return [shard_leaves[i] for i in self.groups[gi].indices]
+
+    def gather_group(
+        self,
+        group_shard_leaves: Sequence,
+        gi: int,
+        site_prefix: str = "zero.gather",
+        axis_name: str = DATA_AXIS,
+    ) -> list:
+        """One group's local shards -> its FULL leaves (group leaf
+        order), bucketed all_gathers under the group-named ledger site
+        `<site_prefix>.<group>` — the per-group seam the comms ledger
+        and the schedule sanitizer observe."""
+        g = self.groups[gi]
+        return g.plan.gather(
+            group_shard_leaves, site=f"{site_prefix}.{g.name}", axis_name=axis_name
+        )
+
+    def scatter_leaves(self, full_leaves: Sequence, gi: int) -> list:
+        """Full leaves of group `gi` -> (n, m) persistent layout."""
+        return self.groups[gi].plan.shard_leaves(full_leaves)
+
+    def peak_full_bytes(self) -> int:
+        """Transient full-param high-water mark of the one-group-ahead
+        schedule: max over adjacent group pairs (a single group when
+        there is only one)."""
+        sizes = [g.full_bytes for g in self.groups]
+        if not sizes:
+            return 0
+        if len(sizes) == 1:
+            return sizes[0]
+        return max(a + b for a, b in zip(sizes, sizes[1:]))
+
+    def total_full_bytes(self) -> int:
+        return sum(g.full_bytes for g in self.groups)
+
+    def describe(self) -> list[dict]:
+        """Static per-group table (bench/report surface)."""
+        return [
+            {
+                "group": g.name,
+                "leaves": len(g.indices),
+                "buckets": len(g.plan.buckets),
+                "full_bytes": g.full_bytes,
+            }
+            for g in self.groups
         ]
 
 

@@ -370,6 +370,13 @@ def _train_impl(
     # layout is static) — the ZeRO stages' memory A/B gauge, available
     # on every backend including CPU meshes where memory_stats is not.
     hbm_state_bytes = tree_shard_bytes(state)
+    # Analytic PEAK model-param footprint per device (shards + the
+    # transient gathered full params): whole-tree for plain zero23, the
+    # largest adjacent group pair under layer-granular gathering — the
+    # gauge that proves the per-layer schedule's memory claim on hosts
+    # without memory_stats. None outside zero23.
+    hbm_model_peak_bytes = getattr(step_fn, "hbm_model_peak_bytes", None)
+    zero_layer = zero23 and config.parallel.zero_layer_granular
 
     # Strict tracing (mocolint runtime arm): tracer-leak checking plus a
     # compile-cache-miss counter over the jitted step, read only on log
@@ -874,6 +881,20 @@ def _train_impl(
                         # ZeRO-2/3 hoisted-gather overlap efficiency —
                         # absent without the gather worker
                         **(gatherer.payload() if gatherer is not None else {}),
+                        # layer-granular stage: mirror the gauge under its
+                        # own key so dashboards can tell the per-group
+                        # schedule apart from whole-tree gathering, and
+                        # publish the analytic peak model footprint
+                        **(
+                            {"overlap/zero_layer": gatherer.last_overlap}
+                            if zero_layer and gatherer is not None
+                            else {}
+                        ),
+                        **(
+                            {"hbm_model_peak_bytes": hbm_model_peak_bytes}
+                            if hbm_model_peak_bytes is not None
+                            else {}
+                        ),
                     }
                     # fault-tolerance observability: only present
                     # when nonzero, so clean runs keep clean lines

@@ -60,6 +60,15 @@ class MocoConfig:
     # on one chip) can reproduce the phenomenon deliberately; never set
     # it in a training recipe.
     allow_leaky_bn: bool = False
+    # Momentum-statistics BN ("Momentum² Teacher", arXiv:2101.07525):
+    # every training BN normalizes with — and stores — the
+    # momentum-updated running statistics m*ra + (1-m)*batch instead of
+    # the raw batch statistics, decoupling normalization precision from
+    # the per-batch sample. The huge-batch alternative to cross-replica
+    # BN statistics (statistics quality comes from history, so nothing
+    # needs syncing as the batch grows). ResNet only; mutually
+    # exclusive with bn_stats_rows / bn_virtual_groups.
+    bn_momentum_stats: bool = False
     # Key-encoder BatchNorm from RUNNING statistics (the EMAN recipe,
     # arXiv:2101.08482, re-derived TPU-first): the key forward runs
     # eval-mode BN against batch_stats_k, which is EMA-updated each
@@ -188,6 +197,17 @@ class ParallelConfig:
     # so it overlaps the previous step (default); False runs gather +
     # step inline (A/B lever; the overlap/zero gauge is then absent).
     zero_overlap_gather: bool = True
+    # Layer-granular stage 2/3 (true ZeRO-3): the step gathers each
+    # layer group's full params just-in-time (per-group fusion buckets,
+    # `comms/zero.gather.<group>` sites) and the rematerialized group
+    # segments free them after their forward/backward contribution, so
+    # transient model memory drops from full-tree to ~two adjacent
+    # groups — the per-chip-batch capacity unlock. Bit-identical loss
+    # trajectory to the whole-tree stages (tests assert it). Requires
+    # zero_stage >= 2, num_model == 1, and an elementwise optimizer;
+    # checkpoint layout is unchanged (the same (n, m) shards), so
+    # resume round-trips freely across zero1/zero23/layer-granular.
+    zero_layer_granular: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -541,13 +561,22 @@ PRESETS = {
         optim=OptimConfig(lr=0.03, epochs=200, cos=True),
         data=DataConfig(dataset="imagefolder", aug_plus=True),
     ),
-    # configs[3]: pod-scale large-batch + LARS (v4-128-class)
+    # configs[3]: pod-scale large-batch + LARS (v4-128-class). Raised to
+    # 8192 once layer-granular ZeRO-3 freed the per-chip headroom; the
+    # hyperparameters stay declared at the 4096 reference and the
+    # scaling-law rules derive the live ones (κ=2: lr×2, momentum^2 —
+    # the README "scaling up batch size correctly" runbook), with
+    # momentum-statistics BN standing in for cross-replica statistics.
+    # NB: LARS needs whole-tensor trust ratios, so THIS preset cannot
+    # also turn on the sharded weight update — the ZeRO-3 huge-batch
+    # recipe is the vit preset below.
     "imagenet_v2_large_batch": TrainConfig(
-        moco=_v2(MocoConfig()),
+        moco=_v2(MocoConfig(), bn_momentum_stats=True),
         optim=OptimConfig(
             optimizer="lars", lr=4.8, weight_decay=1e-6, epochs=200, cos=True, warmup_epochs=10
         ),
-        data=DataConfig(dataset="imagefolder", aug_plus=True, global_batch=4096),
+        data=DataConfig(dataset="imagefolder", aug_plus=True, global_batch=8192),
+        auto_scale="ref_batch=4096",
     ),
     # NOTE (r5): the former `imagenet_v2_eman` preset was DEMOTED to a
     # documented experiment. The EMAN-style key forward
@@ -573,6 +602,29 @@ PRESETS = {
             cos=True, warmup_epochs=40,
         ),
         data=DataConfig(dataset="imagefolder", aug_plus=True, global_batch=4096),
+    ),
+    # Huge-batch v3 on the layer-granular ZeRO-3 memory budget: the
+    # vit_b16_v3 recipe declared at its 4096 reference batch, run at
+    # 8192 with the scaling-law rules deriving lr/momentum (κ=2) and
+    # params + optimizer state persistently sharded, gathered one layer
+    # group at a time (transient model memory ≈ two encoder blocks
+    # instead of the full tree — the headroom the doubled batch spends).
+    # AdamW is elementwise, so the sharded update is eligible (unlike
+    # the LARS preset above).
+    "vit_b16_v3_huge_batch_zero3": TrainConfig(
+        moco=MocoConfig(
+            arch="vit_b16", dim=256, num_negatives=0, momentum=0.99,
+            momentum_cos=True, temperature=0.2, v3=True, shuffle="none",
+        ),
+        optim=OptimConfig(
+            optimizer="adamw", lr=2.4e-3, weight_decay=0.1, epochs=300,
+            cos=True, warmup_epochs=40,
+        ),
+        data=DataConfig(dataset="imagefolder", aug_plus=True, global_batch=8192),
+        parallel=ParallelConfig(
+            shard_weight_update=True, zero_stage=3, zero_layer_granular=True
+        ),
+        auto_scale="ref_batch=4096",
     ),
     # Long-sequence showcase (beyond the reference): 448px inputs give a
     # 784-token ViT-B/16; tokens shard over an 8-way model axis with ring

@@ -249,3 +249,14 @@ PROMOTION_GATED_VALIDATORS = (
     "promotion/stage",
     "promotion/verdict",
 )
+
+# The scaling-law battery's validators scripts/scaling_smoke.py must
+# exercise (per-leg verdict lines + the numeric kappa/drift/peak family
+# all flow through schema.validate_line, so coverage proves the battery
+# emitted real evidence, not just an exit code).
+
+SCALING_GATED_VALIDATORS = (
+    "scaling/",
+    "scaling/leg",
+    "scaling/verdict",
+)

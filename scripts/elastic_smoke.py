@@ -10,7 +10,8 @@ resume — with loss continuity against an uninterrupted control.
 device doubles as a simulated "host" — the FleetAggregator's
 one-device-per-host convention.)
 
-Two ZeRO-2/3 driver runs on the same seed:
+Two ZeRO-2/3 driver runs (layer-granular per-group gather schedule —
+the rescale leg for ISSUE 20's new stage) on the same seed:
 
   control  uninterrupted fake-8 run (3 epochs × 2 steps, batch 64)
   chaos    same config + `--elastic`, with `kill@host=2:at=3` injected:
@@ -83,9 +84,14 @@ def _config(workdir: str, elastic: bool, sanitize_threads: bool = False):
         ),
         optim=OptimConfig(lr=0.03, epochs=EPOCHS, cos=True),
         data=DataConfig(dataset="synthetic", image_size=16, global_batch=64, num_workers=2),
-        # ZeRO-2/3: the rescale must route the persistent flat shards
-        # through reshard_state, not just replicated params
-        parallel=ParallelConfig(num_data=8, shard_weight_update=True, zero_stage=3),
+        # ZeRO-2/3 with the layer-granular schedule (ISSUE 20): the
+        # rescale must route the persistent flat shards through
+        # reshard_state, not just replicated params — and the per-group
+        # gather pipeline must survive an 8 -> 4 mesh rebuild mid-run
+        parallel=ParallelConfig(
+            num_data=8, shard_weight_update=True, zero_stage=3,
+            zero_layer_granular=True,
+        ),
         workdir=workdir,
         log_every=1,
         steps_per_epoch=SPE,

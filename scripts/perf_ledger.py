@@ -76,6 +76,15 @@ PROMOTE_PAUSE_CAP_ACCEL = 5000.0
 PROMOTE_PAUSE_CAP_CPU = 15000.0
 PROMOTE_SWAP_P99_CAP_ACCEL = 5000.0
 PROMOTE_SWAP_P99_CAP_CPU = 30000.0
+# layer-granular ZeRO-3 memory claim (ISSUE 20): the per-layer-group
+# gather/free schedule exists to cut the PEAK model bytes from the
+# whole gathered tree to shards + one live group — its analytic peak
+# must stay at or below half the whole-tree zero23 peak. Analytic on
+# both platforms (no memory_stats dependence), so the gate is hard
+# everywhere, including CPU-smoke rounds. The step RATE is reported
+# informationally only: rematerialized backward re-gathers trade
+# compute for memory by design.
+ZERO_LAYER_PEAK_MAX_RATIO = 0.5
 
 # bench-JSON fields copied into a ledger entry when present
 TRACKED_FIELDS = (
@@ -337,6 +346,29 @@ def check(ledger_path: str, input_path: str, threshold: float | None = None) -> 
             ann["metric"], "fused qps vs composed ivf",
             fused.get("qps"), ann.get("value"),
         )
+    # layer-granular ZeRO-3 memory gate (in-record, like the tier-ratio
+    # gates): analytic peak model bytes of the zero_layer leg vs the
+    # whole-tree zero23 leg. Skip-record legs carry ran=False and no
+    # peaks, so single-device rounds pass through with the reason
+    # already in the skip ledger.
+    zero_ab = rec.get("zero_ab") or {}
+    peak23 = (zero_ab.get("zero23") or {}).get("hbm_model_peak_bytes_analytic")
+    peakl = (zero_ab.get("zero_layer") or {}).get("hbm_model_peak_bytes_analytic")
+    if peak23 and peakl:
+        ratio = peakl / peak23
+        verdict = "PASS" if ratio <= ZERO_LAYER_PEAK_MAX_RATIO else "FAIL"
+        print(
+            f"perf gate [{verdict}] {rec['metric']}: zero_layer peak model bytes "
+            f"{ratio:.2f}x of zero23 (cap {ZERO_LAYER_PEAK_MAX_RATIO:g}x)"
+        )
+        rc |= 0 if verdict == "PASS" else 1
+        rate23 = (zero_ab.get("zero23") or {}).get("imgs_per_sec_per_chip")
+        ratel = (zero_ab.get("zero_layer") or {}).get("imgs_per_sec_per_chip")
+        if rate23 and ratel:
+            print(
+                f"  zero_layer rate vs zero23: {ratel / rate23:.2f}x "
+                "(informational: remat re-gathers trade rate for peak memory)"
+            )
     # informational deltas for the secondary series (never gating —
     # they gate the day they prove stable enough)
     baseline = None

@@ -384,3 +384,134 @@ class TestVirtualGroupBatchNorm:
         jax.tree.map(
             lambda a, b: np.testing.assert_allclose(a, b, atol=1e-4), stats_m, stats_v
         )
+
+
+class TestMomentumStatsBatchNorm:
+    """Momentum-statistics BN (Momentum² Teacher, arXiv:2101.07525
+    §3.2): normalize with the momentum-UPDATED running statistics and
+    store them — the large-batch alternative to cross-replica BN."""
+
+    def test_normalizes_and_stores_momentum_updated_stats(self):
+        from moco_tpu.models.resnet import BatchNorm
+
+        x = jax.random.normal(jax.random.PRNGKey(0), (16, 3, 3, 5)) * 2 + 1
+        bn = BatchNorm(momentum_stats=True, use_running_average=False, momentum=0.5)
+        v = bn.init(jax.random.PRNGKey(1), x)
+        y, mut = bn.apply(v, x, mutable=["batch_stats"])
+        xf = np.asarray(x, np.float64)
+        bmean = xf.mean(axis=(0, 1, 2))
+        bvar = (xf**2).mean(axis=(0, 1, 2)) - bmean**2
+        # m_new = m * running + (1 - m) * batch, from the init stats (0, 1)
+        m_mean = 0.5 * 0.0 + 0.5 * bmean
+        m_var = 0.5 * 1.0 + 0.5 * bvar
+        np.testing.assert_allclose(
+            np.asarray(mut["batch_stats"]["mean"]), m_mean, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(mut["batch_stats"]["var"]), m_var, atol=1e-5
+        )
+        # ... and the NORMALIZATION used m_new, not the raw batch moments
+        expect = (xf - m_mean) / np.sqrt(m_var + 1e-5)
+        np.testing.assert_allclose(np.asarray(y), expect, atol=1e-4)
+
+    def test_gradient_flows_through_batch_term(self):
+        from moco_tpu.models.resnet import BatchNorm
+
+        x = jax.random.normal(jax.random.PRNGKey(0), (8, 2, 2, 3))
+        bn = BatchNorm(momentum_stats=True, use_running_average=False, momentum=0.9)
+        v = bn.init(jax.random.PRNGKey(1), x)
+        g = jax.grad(lambda x: bn.apply(v, x, mutable=["batch_stats"])[0].sum())(x)
+        assert np.isfinite(np.asarray(g)).all()
+        assert np.abs(np.asarray(g)).max() > 0  # the (1-m)*batch path is live
+
+    def test_eval_mode_unchanged(self):
+        """Eval normalizes with the stored running average exactly like
+        plain BN — checkpoints interchange across the mode flag."""
+        from moco_tpu.models.resnet import BatchNorm
+
+        x = jax.random.normal(jax.random.PRNGKey(0), (8, 2, 2, 3))
+        stats = {
+            "mean": jnp.asarray([0.3, -0.1, 0.7]),
+            "var": jnp.asarray([1.2, 0.5, 2.0]),
+        }
+        mom = BatchNorm(momentum_stats=True, use_running_average=True)
+        plain = BatchNorm(use_running_average=True)
+        v = plain.init(jax.random.PRNGKey(1), x)
+        ym = mom.apply({"params": v["params"], "batch_stats": stats}, x)
+        yp = plain.apply({"params": v["params"], "batch_stats": stats}, x)
+        np.testing.assert_array_equal(np.asarray(ym), np.asarray(yp))
+
+    def test_mutually_exclusive_with_other_stats_modes(self):
+        from moco_tpu.core import build_encoder
+        from moco_tpu.models.resnet import BatchNorm
+        from moco_tpu.utils.config import MocoConfig
+
+        x = jnp.zeros((4, 2, 2, 3))
+        bn = BatchNorm(momentum_stats=True, stats_rows=2, use_running_average=False)
+        with pytest.raises(ValueError, match="momentum_stats"):
+            bn.init(jax.random.PRNGKey(0), x)
+        bn = BatchNorm(momentum_stats=True, virtual_groups=2, use_running_average=False)
+        with pytest.raises(ValueError, match="momentum_stats"):
+            bn.init(jax.random.PRNGKey(0), x)
+        # ViT has no BN: the encoder factory rejects the flag up front
+        cfg = MocoConfig(
+            arch="vit_tiny", v3=True, shuffle="none", vit_patch_size=4,
+            bn_momentum_stats=True,
+        )
+        with pytest.raises(ValueError, match="bn_momentum_stats"):
+            build_encoder(cfg)
+
+
+class TestLayerGroupedApply:
+    """The layer-granular ZeRO-3 seam (ISSUE 20): applying the backbone
+    group by group — the param tree restricted to each group's own
+    children — must reproduce the whole-model apply BIT-identically,
+    and the declared group->param-child map must tile the tree."""
+
+    def _grouped_forward(self, model, variables, x, train=True):
+        names = model.group_param_names()
+        stats = variables.get("batch_stats", {})
+        out = x
+        for g in model.group_names:
+            params_g = {k: variables["params"][k] for k in names[g]}
+            out, mut = model.apply(
+                {"params": params_g, "batch_stats": stats},
+                out, train=train, group=g, mutable=["batch_stats"],
+            )
+            stats = {**stats, **mut.get("batch_stats", {})}
+        return out, stats
+
+    @pytest.mark.parametrize("arch", ["resnet18", "resnet50"])
+    def test_grouped_matches_whole_apply_bitwise(self, arch):
+        model = create_resnet(arch, cifar_stem=True)
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 16, 16, 3))
+        v = model.init(jax.random.PRNGKey(1), x, train=False)
+        whole, mut = model.apply(
+            v, x, train=True, mutable=["batch_stats"]
+        )
+        grouped, stats = self._grouped_forward(model, v, x)
+        np.testing.assert_array_equal(np.asarray(whole), np.asarray(grouped))
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+            mut["batch_stats"], stats,
+        )
+
+    def test_group_param_names_tile_the_tree(self):
+        model = create_resnet("resnet18", cifar_stem=True)
+        v = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 16, 16, 3)), train=False
+        )
+        names = model.group_param_names()
+        claimed = [c for g in model.group_names for c in names[g]]
+        assert sorted(claimed) == sorted(v["params"].keys())
+        assert len(claimed) == len(set(claimed))
+
+    def test_unknown_group_rejected(self):
+        model = create_resnet("resnet18", cifar_stem=True)
+        v = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 16, 16, 3)), train=False
+        )
+        with pytest.raises(ValueError, match="unknown layer group"):
+            model.apply(v, jnp.zeros((1, 16, 16, 3)), train=True, group="nope")
+        with pytest.raises(ValueError, match="out of range"):
+            model.apply(v, jnp.zeros((1, 16, 16, 3)), train=True, group="block99")

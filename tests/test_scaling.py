@@ -1,0 +1,103 @@
+"""Scaling-law battery (scripts/scaling_smoke.py, ISSUE 20): the pure
+verdict function, the schema-validated scaling/* ledger, and the
+auto-scale wiring the harness legs rely on. The full trainings live in
+the smoke itself (CI tier-1 job); these tests pin the battery's
+decision logic without compiling a step."""
+
+import json
+
+import pytest
+
+from tests.conftest import load_script
+
+smoke = load_script("scaling_smoke.py")
+
+
+def _gauges(drift=0.01, gap=1.0, fstd=0.04, **kw):
+    g = {"ema_drift": drift, "logit_gap": gap, "feature_std_norm": fstd}
+    g.update(kw)
+    return g
+
+
+def test_evaluate_leg_auto_passes_control_fails():
+    ref = 0.01
+    auto = smoke.evaluate_leg(_gauges(drift=0.0095), ref)
+    assert auto["verdict"] == "PASS" and auto["failed_checks"] == []
+    assert auto["drift_ratio"] == pytest.approx(0.95)
+    # the constant-momentum signature: drift ratio well over the band
+    ctrl = smoke.evaluate_leg(_gauges(drift=0.019), ref)
+    assert ctrl["verdict"] == "FAIL"
+    assert ctrl["failed_checks"] == ["drift_ratio"]
+    # the band itself is exclusive: landing exactly on it fails
+    edge = smoke.evaluate_leg(_gauges(drift=ref * smoke.DRIFT_RATIO_MAX), ref)
+    assert "drift_ratio" in edge["failed_checks"]
+
+
+def test_evaluate_leg_gap_and_collapse_gates():
+    ref = 0.01
+    flat = smoke.evaluate_leg(_gauges(gap=0.0), ref)
+    assert flat["failed_checks"] == ["logit_gap"]
+    collapsed = smoke.evaluate_leg(
+        _gauges(fstd=smoke.FEATURE_STD_FLOOR / 2), ref
+    )
+    assert collapsed["failed_checks"] == ["feature_std"]
+    # gates compose: a leg can fail several at once
+    dead = smoke.evaluate_leg(_gauges(drift=0.05, gap=-0.1, fstd=0.0), ref)
+    assert dead["failed_checks"] == ["drift_ratio", "feature_std", "logit_gap"]
+
+
+def test_ledger_lines_are_schema_valid(tmp_path):
+    from moco_tpu.obs import schema
+
+    path = str(tmp_path / "scaling_battery.jsonl")
+    ledger = smoke.Ledger(path)
+    ledger.emit(
+        "kappa4", "PASS", 8,
+        {"kappa": 4.0, "drift_ratio": 0.94, "logit_gap": 0.01,
+         "feature_std_norm": 0.013},
+    )
+    ledger.emit("zero_layer_ab", "PASS", 8, {"peak_ratio": 2.29, "overlap_zero": 0.54})
+    with open(path) as f:
+        lines = [json.loads(l) for l in f if l.strip()]
+    assert [r["scaling/leg"] for r in lines] == ["kappa4", "zero_layer_ab"]
+    for rec in lines:
+        assert schema.validate_line(rec) == []
+    # a malformed verdict (numeric where the schema wants a string) is
+    # rejected at write time, not discovered downstream
+    with pytest.raises(AssertionError, match="schema"):
+        ledger.emit("bad", 1, 8, {})  # type: ignore[arg-type]
+
+
+def test_scaling_gated_validators_resolve_in_schema():
+    """Every runtime-coverage gate in utils/contracts.py must name a
+    validator obs/schema.py actually applies (explicit field or prefix
+    family) — a gate on a validator that can never fire would fail
+    every future --contract-coverage smoke."""
+    from moco_tpu.obs import schema
+    from moco_tpu.utils.contracts import SCALING_GATED_VALIDATORS
+
+    for gate in SCALING_GATED_VALIDATORS:
+        assert gate in schema.FIELD_VALIDATORS or gate in schema.PREFIX_VALIDATORS, gate
+
+
+def test_harness_legs_apply_the_scaling_rules(tmp_path):
+    """The auto legs' config derives lr*kappa and momentum^kappa from
+    the kappa=1 reference recipe — the exact rules the battery then
+    verifies behaviorally."""
+    from moco_tpu.utils.config import apply_auto_scale
+
+    cfg = smoke._config(
+        str(tmp_path), batch=smoke.REF_BATCH * 4, lr=smoke.REF_LR,
+        momentum=smoke.REF_MOMENTUM, auto_scale=f"ref_batch={smoke.REF_BATCH}",
+    )
+    derived, info = apply_auto_scale(cfg)
+    assert info["kappa"] == pytest.approx(4.0)
+    assert derived.optim.lr == pytest.approx(smoke.REF_LR * 4)
+    assert derived.moco.momentum == pytest.approx(smoke.REF_MOMENTUM**4)
+    # the control leg declares no reference: its config passes through
+    ctrl = smoke._config(
+        str(tmp_path), batch=smoke.REF_BATCH * 4, lr=smoke.REF_LR * 4,
+        momentum=smoke.REF_MOMENTUM,
+    )
+    same, none_info = apply_auto_scale(ctrl)
+    assert none_info is None and same.optim.lr == pytest.approx(smoke.REF_LR * 4)

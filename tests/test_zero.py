@@ -39,7 +39,11 @@ IMG, BATCH = 16, 16
 
 
 def _config(
-    zero: bool, optimizer: str = "sgd", v3: bool = False, stage: int = 1
+    zero: bool,
+    optimizer: str = "sgd",
+    v3: bool = False,
+    stage: int = 1,
+    layer: bool = False,
 ) -> TrainConfig:
     return TrainConfig(
         moco=MocoConfig(
@@ -68,11 +72,12 @@ def _config(
             # tiny fusion buckets so even the toy model exercises
             # multi-bucket packing (and the ragged tail)
             zero_bucket_mb=0.002,
+            zero_layer_granular=layer,
         ),
     )
 
 
-def _run_steps(config: TrainConfig, n_steps: int = 2):
+def _run_steps(config: TrainConfig, n_steps: int = 2, return_step: bool = False):
     mesh = create_mesh(num_data=8)
     encoder = build_encoder(config.moco, num_data=8)
     predictor = build_predictor(config.moco, num_data=8)
@@ -101,6 +106,8 @@ def _run_steps(config: TrainConfig, n_steps: int = 2):
         batch = shard_batch(mesh, {"im_q": ims[0], "im_k": ims[1]})
         state, metrics = step(state, batch, rng)
         losses.append(float(metrics["loss"]))
+    if return_step:
+        return state, losses, step
     return state, losses
 
 
@@ -195,6 +202,105 @@ def test_zero23_update_bit_identical_to_zero1():
     assert tree_shard_bytes(s23) < 0.5 * tree_shard_bytes(s1)
 
 
+def test_zero_layer_granular_bit_identical_and_peak():
+    """Tentpole invariant (ISSUE 20): the layer-granular schedule —
+    per-group just-in-time gathers inside rematerialized segments, one
+    group prefetched ahead, AD-transpose psum_scatter landing summed
+    cotangents on the shards — reproduces the whole-tree stage-2/3 step
+    BIT-identically on ResNet (losses, params, opt state, both stats
+    collections), while the analytic peak model bytes drop >= 2x below
+    the whole-tree gather's."""
+    s23, l23, st23 = _run_steps(_config(zero=True, stage=3), return_step=True)
+    sl, ll, stl = _run_steps(
+        _config(zero=True, stage=3, layer=True), return_step=True
+    )
+    assert l23 == ll, f"loss trajectories diverged: {l23} vs {ll}"
+    cfg = _config(zero=True, stage=3)
+    shapes = full_param_shapes(cfg, build_encoder(cfg.moco, num_data=8))
+    for name in ("params_q", "params_k"):
+        a = unshard_tree_host(getattr(s23, name), shapes["enc"])
+        b = unshard_tree_host(getattr(sl, name), shapes["enc"])
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    for x, y in zip(jax.tree.leaves(s23.opt_state), jax.tree.leaves(sl.opt_state)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    for coll in ("batch_stats_q", "batch_stats_k"):
+        for x, y in zip(
+            jax.tree.leaves(getattr(s23, coll)), jax.tree.leaves(getattr(sl, coll))
+        ):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    # the memory claim, analytically: shards + one live group pair vs
+    # shards + the whole gathered tree
+    assert stl.layer_granular and not st23.layer_granular
+    assert stl.hbm_model_peak_bytes * 2 <= st23.hbm_model_peak_bytes, (
+        f"layer-granular peak {stl.hbm_model_peak_bytes} not >=2x below "
+        f"whole-tree {st23.hbm_model_peak_bytes}"
+    )
+    # the schedule is the model's declared group order
+    assert [g.name for g in stl.group_plan.groups] == list(
+        build_encoder(cfg.moco, num_data=8).backbone.group_names
+    ) + ["head"]
+
+
+@pytest.mark.slow  # two extra v3 step compiles (ViT + predictor path)
+def test_zero_layer_granular_v3_loss_bitwise():
+    """The v3 (ViT + predictor) layer schedule: loss trajectory bitwise
+    vs whole-tree zero23. Params are NOT asserted bitwise here:
+    `jax.checkpoint` alone shifts ViT backward gradients by ~1e-9 on CPU
+    (XLA fuses the rematerialized backward differently), and adamw's
+    sign-like step-1 normalization amplifies that — see the note in
+    core/moco.py's `_make_q_segment`."""
+    _, l23 = _run_steps(_config(zero=True, stage=3, v3=True, optimizer="adamw"))
+    _, ll = _run_steps(
+        _config(zero=True, stage=3, v3=True, optimizer="adamw", layer=True)
+    )
+    assert l23 == ll, f"v3 loss trajectories diverged: {l23} vs {ll}"
+
+
+def test_zero_layer_granular_requires_stage23():
+    """The layer flag without persistent param shards is a config error,
+    not a silent fallback."""
+    config = _config(zero=True, stage=1, layer=True)
+    mesh = create_mesh(num_data=8)
+    encoder = build_encoder(config.moco, num_data=8)
+    tx = build_optimizer(config.optim, steps_per_epoch=4)
+    state = create_state(
+        jax.random.PRNGKey(0), config, encoder, tx,
+        jnp.zeros((1, IMG, IMG, 3), jnp.float32), zero_num_data=8,
+    )
+    with pytest.raises(ValueError, match="zero_layer_granular"):
+        make_train_step(config, encoder, tx, mesh, state_template=state)
+
+
+def test_zero_layer_step_donates_shards():
+    """Donation audit: with donate=True the layer-granular step consumes
+    the input state's shard buffers (no silent double-buffering of the
+    persistent (n, m) shards next to the per-group transients)."""
+    config = _config(zero=True, stage=3, layer=True)
+    mesh = create_mesh(num_data=8)
+    encoder = build_encoder(config.moco, num_data=8)
+    tx = build_optimizer(config.optim, steps_per_epoch=4)
+    state = create_state(
+        jax.random.PRNGKey(0), config, encoder, tx,
+        jnp.zeros((1, IMG, IMG, 3), jnp.float32), zero_num_data=8,
+    )
+    step = make_train_step(
+        config, encoder, tx, mesh, total_steps=8, state_template=state,
+        donate=True,
+    )
+    state = place_state(state, mesh, zero=True, zero_params=True)
+    rng = jax.device_put(
+        jax.random.PRNGKey(3),
+        jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec()),
+    )
+    ims = jax.random.normal(jax.random.PRNGKey(10), (2, BATCH, IMG, IMG, 3))
+    batch = shard_batch(mesh, {"im_q": ims[0], "im_k": ims[1]})
+    old_params = jax.tree.leaves(state.params_q)
+    new_state, _ = step(state, batch, rng)
+    jax.block_until_ready(new_state.params_q)
+    assert all(x.is_deleted() for x in old_params), "input shards not donated"
+
+
 def test_bucket_plan_packing_ragged_tail():
     """Greedy per-dtype packing: buckets close at the byte threshold,
     the ragged tail leaf lands in a final smaller bucket, every leaf is
@@ -234,6 +340,77 @@ def test_bucket_plan_splits_dtypes():
     by_dtype = {str(b.dtype): {s.index for s in b.slots} for b in plan.buckets}
     assert by_dtype["float32"] == {0, 2}
     assert by_dtype["int32"] == {1}
+
+
+def test_group_plan_partition_errors_and_peak():
+    """GroupPlan construction is a total partition check: overlapping
+    and missing leaves are errors at build time, and peak_full_bytes is
+    the largest ADJACENT pair (the one-group-ahead liveness bound), not
+    the largest single group or the total."""
+    from moco_tpu.parallel.zero import GroupPlan
+
+    leaves = [
+        jax.ShapeDtypeStruct((64,), jnp.float32),  # 256 B
+        jax.ShapeDtypeStruct((32,), jnp.float32),  # 128 B
+        jax.ShapeDtypeStruct((128,), jnp.float32),  # 512 B
+        jax.ShapeDtypeStruct((8,), jnp.float32),  # 32 B
+    ]
+    with pytest.raises(ValueError, match="re-claims"):
+        GroupPlan(leaves, [("a", (0, 1)), ("b", (1, 2, 3))], n=8)
+    with pytest.raises(ValueError, match="misses"):
+        GroupPlan(leaves, [("a", (0, 1)), ("b", (3,))], n=8)
+    plan = GroupPlan(leaves, [("a", (0,)), ("b", (1, 2)), ("c", (3,))], n=8)
+    assert [g.name for g in plan.groups] == ["a", "b", "c"]
+    assert [g.full_bytes for g in plan.groups] == [256, 640, 32]
+    assert plan.peak_full_bytes() == 256 + 640  # adjacent pair a+b
+    assert plan.total_full_bytes() == 928
+    assert [d["group"] for d in plan.describe()] == ["a", "b", "c"]
+    # single-group degenerate case: the peak is the group itself
+    solo = GroupPlan(leaves[:1], [("only", (0,))], n=8)
+    assert solo.peak_full_bytes() == 256
+
+
+def test_group_plan_gather_matches_whole_tree_gather():
+    """Per-group bucketed gathers reassemble EXACTLY the same full
+    leaves as one whole-tree BucketPlan gather (and the source values):
+    the element->chunk assignment invariant extends across the group
+    partition, so the layer schedule changes memory, not bits."""
+    from moco_tpu.parallel.compat import shard_map
+    from moco_tpu.parallel.zero import GroupPlan
+
+    P = jax.sharding.PartitionSpec
+    n = 8
+    rng = np.random.default_rng(0)
+    full = [
+        jnp.asarray(rng.standard_normal(s).astype(np.float32))
+        for s in ((40,), (33,), (8, 8), (5,))
+    ]
+    descs = [jax.ShapeDtypeStruct(x.shape, x.dtype) for x in full]
+    whole = BucketPlan(descs, n, bucket_bytes=128)
+    gp = GroupPlan(descs, [("a", (0, 1)), ("b", (2, 3))], n, bucket_bytes=128)
+    sharded = whole.shard_leaves(full)  # (n, m) rows, shared layout
+
+    def run(*rows):
+        loc = [r.reshape(-1) for r in rows]
+        out_whole = whole.gather(loc, site="test.zero.gather")
+        ga = gp.gather_group(gp.group_shards(loc, 0), 0, site_prefix="test.zero.layer")
+        gb = gp.gather_group(gp.group_shards(loc, 1), 1, site_prefix="test.zero.layer")
+        return tuple(out_whole), tuple(ga + gb)
+
+    mesh = create_mesh(num_data=n)
+    f = jax.jit(
+        shard_map(
+            run,
+            mesh=mesh,
+            in_specs=tuple(P("data") for _ in sharded),
+            out_specs=(tuple(P() for _ in full), tuple(P() for _ in full)),
+            check_vma=False,
+        )
+    )
+    out_whole, out_groups = f(*sharded)
+    for src, w, g in zip(full, out_whole, out_groups):
+        np.testing.assert_array_equal(np.asarray(w), np.asarray(src))
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(src))
 
 
 def test_reshard_state_layout_roundtrips():
@@ -312,6 +489,42 @@ def test_reshard_state_unequal_mesh_widths():
     back = reshard_state(down_3, live_template=s_rep, full_template=s_rep)
     for a, b in zip(jax.tree.leaves(back.params_q), jax.tree.leaves(s_rep.params_q)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_reshard_layer_granular_roundtrips_and_resume_compat():
+    """Satellite (ISSUE 20): the layer-granular stage rides the zero23
+    persistent layout, so reshard_state round-trips zero1 <-> zero23 <->
+    layer-granular bitwise (including across mesh widths 8 -> 5), and
+    toggling `zero_layer_granular` across a resume is NOT a structural
+    incompatibility (it is a schedule, not a layout)."""
+    from moco_tpu.utils.config import config_to_dict, resume_compat_diff
+
+    cfg_z1 = _config(zero=True, stage=1)
+    cfg_layer = _config(zero=True, stage=3, layer=True)
+    encoder = build_encoder(cfg_z1.moco, num_data=8)
+    tx = build_optimizer(cfg_z1.optim, steps_per_epoch=4)
+    sample = jnp.zeros((1, IMG, IMG, 3), jnp.float32)
+    rng = jax.random.PRNGKey(0)
+    s_rep = create_state(rng, _config(zero=False), encoder, tx, sample)
+    s_z1 = create_state(rng, cfg_z1, encoder, tx, sample, zero_num_data=8)  # mocolint: disable=JX003  (same seed on purpose: bitwise layout roundtrip)
+    s_layer = create_state(rng, cfg_layer, encoder, tx, sample, zero_num_data=8)  # mocolint: disable=JX003  (same seed on purpose, see above)
+    s_layer5 = create_state(rng, cfg_layer, encoder, tx, sample, zero_num_data=5)  # mocolint: disable=JX003  (same seed on purpose, see above)
+
+    up = reshard_state(s_z1, live_template=s_layer, full_template=s_rep)
+    for a, b in zip(jax.tree.leaves(up.params_q), jax.tree.leaves(s_layer.params_q)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    narrow = reshard_state(up, live_template=s_layer5, full_template=s_rep)
+    for a, b in zip(
+        jax.tree.leaves(narrow.opt_state), jax.tree.leaves(s_layer5.opt_state)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    back = reshard_state(narrow, live_template=s_z1, full_template=s_rep)
+    for a, b in zip(jax.tree.leaves(back.params_q), jax.tree.leaves(s_z1.params_q)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # resume-compat: the flag flip produces NO structural diff entries
+    saved = {"config": config_to_dict(_config(zero=True, stage=3)), "num_data": 8}
+    assert resume_compat_diff(saved, cfg_layer, num_data=8) == []
 
 
 def test_embedding_index_rows_survive_width_shrink():

@@ -58,6 +58,13 @@ def build_parser() -> argparse.ArgumentParser:
         "see PROFILE.md / scripts/bn_compile_repro.py)",
     )
     p.add_argument(
+        "--bn-momentum-stats", action="store_true", default=None,
+        help="momentum-statistics BN (Momentum² Teacher, arXiv:2101.07525): "
+        "normalize with the EMA-updated running statistics each train step "
+        "instead of the raw batch moments — the large-batch alternative to "
+        "cross-replica BN statistics (excludes --bn-stats-rows/--bn-virtual-groups)",
+    )
+    p.add_argument(
         "--bn-virtual-groups", type=int, default=None,
         help="virtual Shuffle-BN: per-group BN statistics over G row-groups "
         "+ in-batch key permutation — the reference's G-GPU recipe on one chip",
@@ -233,6 +240,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="run the ZeRO-2/3 params gather inline instead of hoisted "
         "under the previous step (A/B lever)",
     )
+    p.add_argument(
+        "--zero-layer-granular", action="store_true", default=None,
+        help="with --zero-stage 2/3: gather each layer group's full "
+        "params just-in-time (one-group-ahead prefetch) and free them "
+        "after the group's forward/backward — peak model memory drops "
+        "from the whole tree to shards + one live group",
+    )
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--workdir", default=None)
     p.add_argument("--print-freq", "-p", type=int, default=None)
@@ -334,6 +348,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         shuffle=args.shuffle,
         bn_stats_rows=args.bn_stats_rows,
         bn_stats_barrier=args.bn_stats_barrier,
+        bn_momentum_stats=args.bn_momentum_stats,
         bn_virtual_groups=args.bn_virtual_groups,
         key_bn_running_stats=args.key_bn_running_stats,
         key_bn_stats_warmup=args.key_bn_stats_warmup,
@@ -374,6 +389,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         zero_stage=args.zero_stage,
         zero_bucket_mb=args.zero_bucket_mb,
         zero_overlap_gather=args.zero_overlap_gather,
+        zero_layer_granular=args.zero_layer_granular,
     )
     return override(
         dataclasses.replace(cfg, moco=moco, optim=optim, data=data, parallel=parallel),
